@@ -1,0 +1,3 @@
+"""Base layer (L0–L1): logging/CHECK/Error, timer, env, registry, parameter,
+config.  Reference: include/dmlc/{logging,timer,parameter,registry,config}.h
+(see SURVEY.md §2a)."""

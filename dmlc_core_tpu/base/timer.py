@@ -1,0 +1,49 @@
+"""Wall-clock timing.
+
+Reference parity: ``include/dmlc/timer.h :: dmlc::GetTime()`` (SURVEY.md §2a),
+extended with a ``Timer`` context manager and a device-aware
+:func:`block_until_ready_time` helper, because on TPU the number you almost
+always want is *device* step time (dispatch is async; naive wall-clock timing
+measures nothing).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+__all__ = ["get_time", "Timer", "block_until_ready_time"]
+
+
+def get_time() -> float:
+    """Seconds since an arbitrary epoch, monotonic, high resolution."""
+    return time.perf_counter()
+
+
+class Timer:
+    """``with Timer() as t: ...; t.elapsed`` — simple scoped timer."""
+
+    def __init__(self) -> None:
+        self.start: float = 0.0
+        self.elapsed: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        self.start = get_time()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.elapsed = get_time() - self.start
+
+
+def block_until_ready_time(fn, *args, **kwargs) -> tuple[Any, float]:
+    """Run ``fn`` and block on its jax outputs; return (result, seconds).
+
+    The correct way to time a jitted step: async dispatch means wall-clock
+    around the call alone under-reports.  Non-jax results pass through.
+    """
+    import jax
+
+    t0 = get_time()
+    out = fn(*args, **kwargs)
+    out = jax.block_until_ready(out)
+    return out, get_time() - t0

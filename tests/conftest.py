@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Tests run on CPU with a virtual 8-device mesh so the multi-chip sharding path
+(shard_map / psum over a named Mesh) is exercised without TPU hardware — the
+TPU-world analogue of the reference's ``dmlc_tracker/local.py`` multi-process
+testing pattern (SURVEY.md §4).  Env vars must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
