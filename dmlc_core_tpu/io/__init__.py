@@ -1,0 +1,29 @@
+"""I/O layer (L2–L5): Stream API with URI dispatch, memory streams,
+filesystems, binary serializer, JSON helpers, RecordIO, input splits and the
+threaded prefetch iterator.
+
+Reference parity: include/dmlc/{io,memory_io,serializer,json,recordio,
+threadediter,concurrency,filesystem}.h and src/io/* (SURVEY.md §2a-b).
+"""
+
+from dmlc_core_tpu.io.stream import Stream, SeekStream, Serializable  # noqa: F401
+from dmlc_core_tpu.io.memory_io import (  # noqa: F401
+    MemoryFixedSizeStream,
+    MemoryStringStream,
+)
+from dmlc_core_tpu.io.filesystem import (  # noqa: F401
+    URI,
+    FileInfo,
+    FileSystem,
+    LocalFileSystem,
+    TemporaryDirectory,
+)
+from dmlc_core_tpu.io.threaded_iter import ThreadedIter  # noqa: F401
+from dmlc_core_tpu.io.concurrency import ConcurrentBlockingQueue  # noqa: F401
+from dmlc_core_tpu.io.recordio import (  # noqa: F401
+    RecordIOWriter,
+    RecordIOReader,
+    RecordIOChunkReader,
+    RECORDIO_MAGIC,
+)
+from dmlc_core_tpu.io.input_split import InputSplit  # noqa: F401

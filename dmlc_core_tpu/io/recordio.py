@@ -1,0 +1,186 @@
+"""RecordIO: splittable binary record format.
+
+Reference parity: ``include/dmlc/recordio.h + src/recordio.cc ::
+RecordIOWriter/Reader/ChunkReader, kMagic = 0xced7230a`` (SURVEY.md §2a).
+
+Wire format (must match the reference byte-for-byte — it's the ``.rec``
+format MXNet image pipelines shard over):
+
+* every part: ``[magic:u32le][lrec:u32le][payload][0-pad to 4 bytes]``
+* ``lrec`` = (cflag << 29) | length, cflag ∈ {0 whole, 1 start, 2 middle,
+  3 end}, length < 2^29
+* records containing the magic u32 at a 4-byte-aligned offset are split
+  there: the embedded magic is *consumed* by the writer and re-inserted by
+  the reader when joining parts — so scanning for ``magic`` at aligned
+  offsets always finds true record starts, which is what makes byte-range
+  sharding (``RecordIOSplit``) safe.
+
+Unbounded record size via cflag continuation means arbitrarily long
+sequence records stream through fixed-size chunks — the property the TPU
+data plane inherits for long-context workloads (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, CHECK_LT, log_fatal
+from dmlc_core_tpu.io.stream import Stream
+
+__all__ = [
+    "RECORDIO_MAGIC",
+    "RECORDIO_MAGIC_BYTES",
+    "RecordIOWriter",
+    "RecordIOReader",
+    "RecordIOChunkReader",
+    "encode_lrec",
+    "decode_flag",
+    "decode_length",
+]
+
+RECORDIO_MAGIC = 0xCED7230A
+RECORDIO_MAGIC_BYTES = struct.pack("<I", RECORDIO_MAGIC)
+_U32 = struct.Struct("<I")
+_MAX_LEN = (1 << 29) - 1
+
+
+def encode_lrec(cflag: int, length: int) -> int:
+    """(3-bit cflag | 29-bit length)."""
+    return (cflag << 29) | length
+
+
+def decode_flag(lrec: int) -> int:
+    return (lrec >> 29) & 7
+
+
+def decode_length(lrec: int) -> int:
+    return lrec & _MAX_LEN
+
+
+class RecordIOWriter:
+    """Write records with magic-escaping.  Reference: ``RecordIOWriter``."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self.except_counter = 0  # number of embedded magics escaped
+
+    def write_record(self, data: bytes) -> None:
+        CHECK_LT(len(data), 1 << 29, "RecordIO: record too large")
+        size = len(data)
+        lower_align = (size >> 2) << 2
+        upper_align = ((size + 3) >> 2) << 2
+        dptr = 0
+        # scan 4-byte-aligned offsets for embedded magic; split there
+        pos = data.find(RECORDIO_MAGIC_BYTES)
+        while 0 <= pos < lower_align:
+            if pos % 4 == 0:
+                cflag = 1 if dptr == 0 else 2
+                self._write_part(cflag, data[dptr:pos])
+                dptr = pos + 4  # the magic itself is consumed
+                self.except_counter += 1
+                pos = data.find(RECORDIO_MAGIC_BYTES, dptr)
+            else:
+                pos = data.find(RECORDIO_MAGIC_BYTES, pos + 1)
+        cflag = 3 if dptr != 0 else 0
+        self._write_part(cflag, data[dptr:])
+        if upper_align != size:
+            self._stream.write(b"\x00" * (upper_align - size))
+
+    def _write_part(self, cflag: int, payload: bytes) -> None:
+        self._stream.write(RECORDIO_MAGIC_BYTES)
+        self._stream.write(_U32.pack(encode_lrec(cflag, len(payload))))
+        if payload:
+            self._stream.write(payload)
+            if cflag in (1, 2):
+                # interior parts end exactly where an aligned magic was
+                # consumed, so they are already 4-byte aligned
+                pass
+
+
+class RecordIOReader:
+    """Read records, reassembling escaped parts.  Reference: ``RecordIOReader``."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+
+    def next_record(self) -> Optional[bytes]:
+        """Return the next record, or None at EOF."""
+        parts: list[bytes] = []
+        while True:
+            head = self._stream.read(4)
+            if len(head) == 0:
+                CHECK(not parts, "RecordIO: EOF inside a multi-part record")
+                return None
+            CHECK_EQ(len(head), 4, "RecordIO: truncated magic")
+            magic = _U32.unpack(head)[0]
+            CHECK_EQ(magic, RECORDIO_MAGIC, "RecordIO: bad magic")
+            lrec = _U32.unpack(self._stream.read_exact(4))[0]
+            cflag, clen = decode_flag(lrec), decode_length(lrec)
+            if cflag in (0, 1):
+                CHECK(not parts, "RecordIO: unexpected record start flag")
+            if cflag in (2, 3):
+                parts.append(RECORDIO_MAGIC_BYTES)  # re-insert consumed magic
+            if clen:
+                parts.append(self._stream.read_exact(clen))
+            pad = (((clen + 3) >> 2) << 2) - clen
+            if pad:
+                self._stream.read_exact(pad)
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+
+class RecordIOChunkReader:
+    """Extract records from an in-memory chunk (zero stream round-trips).
+
+    Reference parity: ``RecordIOChunkReader`` — used by the recordio
+    InputSplit, whose chunks are aligned on magic boundaries, so parsing is
+    pure in-memory slicing.  This is the TPU-infeed-friendly path: one
+    storage read produces a chunk, records are sliced out without copies
+    where possible.
+    """
+
+    def __init__(self, chunk: bytes):
+        self._view = memoryview(chunk)
+        self._pos = 0
+
+    def next_record(self) -> Optional[bytes]:
+        parts: list[bytes] = []
+        view, pos = self._view, self._pos
+        while True:
+            if pos >= len(view):
+                CHECK(not parts, "RecordIO chunk: truncated multi-part record")
+                self._pos = pos
+                return None
+            if pos + 8 > len(view):
+                log_fatal("RecordIO chunk: truncated header")
+            magic = _U32.unpack_from(view, pos)[0]
+            CHECK_EQ(magic, RECORDIO_MAGIC, "RecordIO chunk: bad magic")
+            lrec = _U32.unpack_from(view, pos + 4)[0]
+            cflag, clen = decode_flag(lrec), decode_length(lrec)
+            data_end = pos + 8 + clen
+            if data_end > len(view):
+                log_fatal("RecordIO chunk: truncated payload")
+            if cflag in (0, 1):
+                CHECK(not parts, "RecordIO chunk: unexpected start flag")
+            if cflag in (2, 3):
+                parts.append(RECORDIO_MAGIC_BYTES)
+            parts.append(bytes(view[pos + 8 : data_end]))
+            pos = pos + 8 + (((clen + 3) >> 2) << 2)
+            if cflag in (0, 3):
+                self._pos = min(pos, len(view))
+                return b"".join(parts)
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
