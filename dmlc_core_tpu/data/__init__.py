@@ -1,0 +1,12 @@
+"""Data layer (L6): CSR row blocks, text parsers, row-block iterators and
+the TPU device staging path.
+
+Reference parity: ``include/dmlc/data.h`` (Row/RowBlock/Parser/RowBlockIter),
+``src/data/*`` (row_block, text parsers, basic/disk row iters)
+(SURVEY.md §2a-b), re-founded on numpy CSR buffers that stage directly into
+``jax.Array`` device memory (``dmlc_core_tpu.data.device``).
+"""
+
+from dmlc_core_tpu.data.row_block import Row, RowBlock, RowBlockContainer  # noqa: F401
+from dmlc_core_tpu.data.parsers import Parser  # noqa: F401
+from dmlc_core_tpu.data.iter import RowBlockIter  # noqa: F401

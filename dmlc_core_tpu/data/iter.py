@@ -1,0 +1,181 @@
+"""Row-block iterators: in-memory and external-memory (disk-cached).
+
+Reference parity: ``include/dmlc/data.h :: RowBlockIter<I>::Create``,
+``src/data/basic_row_iter.h :: BasicRowIter`` (slurp whole input),
+``src/data/disk_row_iter.h :: DiskRowIter`` (parse once → binary pages on a
+cache file → prefetch-iterate pages) (SURVEY.md §2b).
+
+A ``#cachefile`` suffix on the URI selects the external-memory path, exactly
+like the reference (``RowBlockIter::Create("big.libsvm#cache.bin", ...)``):
+pass 1 streams parser output into RowBlockContainer pages on the cache URI;
+later epochs replay pages through a ThreadedIter so storage read overlaps
+consumption — the same pipeline shape the TPU infeed path reuses
+(``dmlc_core_tpu.data.device``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.data.parsers import Parser, parse_uri_spec
+from dmlc_core_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_core_tpu.io.stream import Stream
+from dmlc_core_tpu.io.threaded_iter import ThreadedIter
+
+__all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter"]
+
+# target bytes per cache page (reference uses a row-count heuristic; byte
+# budget maps better to fixed host-staging buffers)
+_PAGE_BYTES = 64 << 20
+
+
+class RowBlockIter:
+    """Iterator over CSR RowBlocks with rewind.
+
+    Reference: ``dmlc::RowBlockIter<IndexType>`` (DataIter contract:
+    before_first / next / value).
+    """
+
+    @staticmethod
+    def create(uri: str, part: int = 0, nparts: int = 1,
+               format: Optional[str] = None, nthread: int = 0) -> "RowBlockIter":
+        """``#cachefile`` in the URI → external-memory DiskRowIter, else
+        in-memory BasicRowIter.  Reference: ``src/data.cc :: CreateIter_``."""
+        _path, _args, cache = parse_uri_spec(uri)
+        parser = Parser.create(uri, part, nparts, format, nthread)
+        if cache:
+            return DiskRowIter(parser, cache + (f".part{part}" if nparts > 1 else ""))
+        return BasicRowIter(parser)
+
+    # -- DataIter contract ----------------------------------------------
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next_block(self) -> Optional[RowBlock]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[RowBlock]:
+        self.before_first()
+        while True:
+            block = self.next_block()
+            if block is None:
+                return
+            yield block
+
+    @property
+    def num_col(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class BasicRowIter(RowBlockIter):
+    """Slurp the whole parser output into one block at construction.
+
+    Reference: ``basic_row_iter.h`` — the small-data path.
+    """
+
+    def __init__(self, parser: Parser):
+        container = RowBlockContainer()
+        for block in parser:
+            container.push_block(block)
+        parser.close()
+        self._block = container.to_block()
+        self._max_index = container.max_index
+        self._done = False
+
+    def before_first(self) -> None:
+        self._done = False
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._done:
+            return None
+        self._done = True
+        return self._block
+
+    @property
+    def value(self) -> RowBlock:
+        return self._block
+
+    @property
+    def num_col(self) -> int:
+        return self._max_index + 1
+
+
+class DiskRowIter(RowBlockIter):
+    """Parse once to binary pages on a cache URI; iterate pages with
+    prefetch.  Reference: ``disk_row_iter.h`` — the external-memory path
+    (ancestor of XGBoost external memory)."""
+
+    def __init__(self, parser: Parser, cache_uri: str, page_bytes: int = _PAGE_BYTES):
+        self._cache_uri = cache_uri
+        self._max_index = 0
+        self._num_pages = 0
+        self._build_cache(parser, page_bytes)
+        self._iter: Optional[ThreadedIter] = None
+        self._read_stream: Optional[Stream] = None
+
+    def _build_cache(self, parser: Parser, page_bytes: int) -> None:
+        out = Stream.create(self._cache_uri, "w")
+        container = RowBlockContainer()
+        held = 0
+        for block in parser:
+            container.push_block(block)
+            held += block.memory_cost()
+            if held >= page_bytes:
+                container.save(out)
+                self._num_pages += 1
+                self._max_index = max(self._max_index, container.max_index)
+                container.clear()
+                held = 0
+        if container.size:
+            container.save(out)
+            self._num_pages += 1
+            self._max_index = max(self._max_index, container.max_index)
+        out.close()
+        parser.close()
+
+    def _start_reader(self) -> None:
+        self._stop_reader()
+        self._read_stream = Stream.create(self._cache_uri, "r")
+
+        def next_page(_cell) -> Optional[RowBlock]:
+            container = RowBlockContainer()
+            if not container.load(self._read_stream):
+                return None
+            return container.to_block()
+
+        def rewind() -> None:
+            self._read_stream.close()
+            self._read_stream = Stream.create(self._cache_uri, "r")
+
+        self._iter = ThreadedIter(max_capacity=2)
+        self._iter.init(next_page, rewind)
+
+    def _stop_reader(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+            self._iter = None
+        if self._read_stream is not None:
+            self._read_stream.close()
+            self._read_stream = None
+
+    def before_first(self) -> None:
+        if self._iter is None:
+            self._start_reader()
+        else:
+            self._iter.before_first()
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._iter is None:
+            self._start_reader()
+        return self._iter.next()
+
+    @property
+    def num_col(self) -> int:
+        return self._max_index + 1
+
+    def close(self) -> None:
+        self._stop_reader()

@@ -1,0 +1,306 @@
+"""CSR row blocks — the tabular data contract.
+
+Reference parity: ``include/dmlc/data.h :: Row<I>, RowBlock<I>`` (CSR arrays
+offset/label/weight/qid/field/index/value, slice) and ``src/data/row_block.h
+:: RowBlockContainer<I>`` (Push/GetBlock/Clear/Save/Load/max_index)
+(SURVEY.md §2a-b).
+
+TPU-first redesign: where the reference stores C++ vectors, a RowBlock here
+is a bundle of **contiguous numpy arrays** — zero-copy views into parser
+output, directly consumable by ``np.asarray``-free ``jax.device_put`` and by
+the Pallas/XLA histogram kernels (``dmlc_core_tpu.ops``).  The binary page
+format (``save``/``load``) is the external-memory cache format used by
+``DiskRowIter``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, CHECK_LE, log_fatal
+from dmlc_core_tpu.io import serializer as ser
+from dmlc_core_tpu.io.stream import Serializable, Stream
+
+__all__ = ["Row", "RowBlock", "RowBlockContainer"]
+
+
+@dataclass
+class Row:
+    """One sparse row view.  Reference: ``dmlc::Row<IndexType, DType>``."""
+
+    label: float
+    index: np.ndarray
+    value: Optional[np.ndarray]  # None → all ones (binary features)
+    weight: float = 1.0
+    qid: int = 0
+    field: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def get_value(self, i: int) -> float:
+        return 1.0 if self.value is None else float(self.value[i])
+
+    def sdot(self, weights: np.ndarray) -> float:
+        """Sparse dot with a dense weight vector.  Reference: ``Row::SDot``."""
+        if self.value is None:
+            return float(weights[self.index].sum())
+        return float(np.dot(weights[self.index], self.value))
+
+
+class RowBlock:
+    """A block of sparse rows in CSR form.
+
+    Arrays: ``offset`` int64[n+1]; ``label`` float32[n]; optional ``weight``
+    float32[n], ``qid`` int64[n], ``field`` int32[nnz]; ``index`` int64[nnz];
+    optional ``value`` float32[nnz] (None → implicit ones).
+    """
+
+    def __init__(
+        self,
+        offset: np.ndarray,
+        label: np.ndarray,
+        index: np.ndarray,
+        value: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        qid: Optional[np.ndarray] = None,
+        field: Optional[np.ndarray] = None,
+    ):
+        self.offset = np.ascontiguousarray(offset, dtype=np.int64)
+        self.label = np.ascontiguousarray(label, dtype=np.float32)
+        self.index = np.ascontiguousarray(index, dtype=np.int64)
+        self.value = None if value is None else np.ascontiguousarray(value, dtype=np.float32)
+        self.weight = None if weight is None else np.ascontiguousarray(weight, dtype=np.float32)
+        self.qid = None if qid is None else np.ascontiguousarray(qid, dtype=np.int64)
+        self.field = None if field is None else np.ascontiguousarray(field, dtype=np.int32)
+        n = len(self.label)
+        CHECK_EQ(len(self.offset), n + 1, "RowBlock: offset size mismatch")
+        nnz = int(self.offset[-1])
+        CHECK_EQ(len(self.index), nnz, "RowBlock: index size mismatch")
+        if self.value is not None:
+            CHECK_EQ(len(self.value), nnz, "RowBlock: value size mismatch")
+
+    @property
+    def size(self) -> int:
+        return len(self.label)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.offset[-1])
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, i: Union[int, slice]) -> Union[Row, "RowBlock"]:
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self.size)
+            CHECK_EQ(step, 1, "RowBlock slices must be contiguous")
+            return self.slice(start, stop)
+        if i < 0:
+            i += self.size
+        lo, hi = int(self.offset[i]), int(self.offset[i + 1])
+        return Row(
+            label=float(self.label[i]),
+            index=self.index[lo:hi],
+            value=None if self.value is None else self.value[lo:hi],
+            weight=1.0 if self.weight is None else float(self.weight[i]),
+            qid=0 if self.qid is None else int(self.qid[i]),
+            field=None if self.field is None else self.field[lo:hi],
+        )
+
+    def __iter__(self) -> Iterator[Row]:
+        for i in range(self.size):
+            yield self[i]  # type: ignore[misc]
+
+    def slice(self, begin: int, end: int) -> "RowBlock":
+        """Zero-copy contiguous row range.  Reference: ``RowBlock::Slice``."""
+        CHECK_LE(begin, end)
+        CHECK_LE(end, self.size)
+        lo, hi = int(self.offset[begin]), int(self.offset[end])
+        return RowBlock(
+            offset=self.offset[begin : end + 1] - lo,
+            label=self.label[begin:end],
+            index=self.index[lo:hi],
+            value=None if self.value is None else self.value[lo:hi],
+            weight=None if self.weight is None else self.weight[begin:end],
+            qid=None if self.qid is None else self.qid[begin:end],
+            field=None if self.field is None else self.field[lo:hi],
+        )
+
+    @property
+    def max_index(self) -> int:
+        return int(self.index.max()) if len(self.index) else 0
+
+    def memory_cost(self) -> int:
+        """Approximate bytes held (reference: ``RowBlock::MemCostBytes``)."""
+        total = self.offset.nbytes + self.label.nbytes + self.index.nbytes
+        for arr in (self.value, self.weight, self.qid, self.field):
+            if arr is not None:
+                total += arr.nbytes
+        return total
+
+    def to_dense(self, num_col: Optional[int] = None) -> np.ndarray:
+        """Densify to float32 [n, num_col] (missing → 0)."""
+        ncol = num_col if num_col is not None else self.max_index + 1
+        out = np.zeros((self.size, ncol), dtype=np.float32)
+        rows = np.repeat(np.arange(self.size), np.diff(self.offset))
+        vals = self.value if self.value is not None else np.ones(self.nnz, np.float32)
+        out[rows, self.index] = vals
+        return out
+
+
+class RowBlockContainer(Serializable):
+    """Growable CSR builder with binary page (de)serialization.
+
+    Reference parity: ``src/data/row_block.h :: RowBlockContainer<I>`` —
+    this is the external-memory cache-file format.
+    """
+
+    def __init__(self) -> None:
+        self.clear()
+
+    def clear(self) -> None:
+        self._offsets: List[int] = [0]
+        self._labels: List[float] = []
+        self._weights: List[float] = []
+        self._qids: List[int] = []
+        self._index_chunks: List[np.ndarray] = []
+        self._value_chunks: List[Optional[np.ndarray]] = []
+        self._field_chunks: List[Optional[np.ndarray]] = []
+        self._nnz = 0
+        self.max_index = 0
+
+    @property
+    def size(self) -> int:
+        return len(self._labels)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def push(
+        self,
+        label: float,
+        index: Sequence[int],
+        value: Optional[Sequence[float]] = None,
+        weight: float = 1.0,
+        qid: int = 0,
+        field: Optional[Sequence[int]] = None,
+    ) -> None:
+        """Append one row.  Reference: ``RowBlockContainer::Push(Row)``."""
+        idx = np.asarray(index, dtype=np.int64)
+        self._index_chunks.append(idx)
+        self._value_chunks.append(
+            None if value is None else np.asarray(value, dtype=np.float32)
+        )
+        self._field_chunks.append(
+            None if field is None else np.asarray(field, dtype=np.int32)
+        )
+        self._nnz += len(idx)
+        self._offsets.append(self._nnz)
+        self._labels.append(float(label))
+        self._weights.append(float(weight))
+        self._qids.append(int(qid))
+        if len(idx):
+            self.max_index = max(self.max_index, int(idx.max()))
+
+    def push_block(self, block: RowBlock) -> None:
+        """Append a whole RowBlock (bulk path used by parsers)."""
+        self._index_chunks.append(block.index)
+        self._value_chunks.append(block.value)
+        self._field_chunks.append(block.field)
+        base = self._nnz
+        self._nnz += block.nnz
+        self._offsets.extend((block.offset[1:] + base).tolist())
+        self._labels.extend(block.label.tolist())
+        w = block.weight if block.weight is not None else np.ones(block.size, np.float32)
+        self._weights.extend(w.tolist())
+        q = block.qid if block.qid is not None else np.zeros(block.size, np.int64)
+        self._qids.extend(q.tolist())
+        if block.nnz:
+            self.max_index = max(self.max_index, block.max_index)
+
+    def to_block(self) -> RowBlock:
+        """Materialize the accumulated rows.  Reference: ``GetBlock``."""
+        nnz = self._nnz
+        index = (
+            np.concatenate(self._index_chunks)
+            if self._index_chunks
+            else np.empty(0, np.int64)
+        )
+        has_value = any(v is not None for v in self._value_chunks)
+        value = None
+        if has_value:
+            value = np.concatenate(
+                [
+                    v if v is not None else np.ones(len(i), np.float32)
+                    for v, i in zip(self._value_chunks, self._index_chunks)
+                ]
+            ) if self._value_chunks else np.empty(0, np.float32)
+        has_field = any(f is not None for f in self._field_chunks)
+        field = None
+        if has_field:
+            field = np.concatenate(
+                [
+                    f if f is not None else np.zeros(len(i), np.int32)
+                    for f, i in zip(self._field_chunks, self._index_chunks)
+                ]
+            )
+        weights = np.asarray(self._weights, dtype=np.float32)
+        qids = np.asarray(self._qids, dtype=np.int64)
+        return RowBlock(
+            offset=np.asarray(self._offsets, dtype=np.int64),
+            label=np.asarray(self._labels, dtype=np.float32),
+            index=index,
+            value=value,
+            weight=None if np.all(weights == 1.0) else weights,
+            qid=None if np.all(qids == 0) else qids,
+            field=field,
+        )
+
+    # -- binary page format (the disk-cache format) ----------------------
+    _PAGE_MAGIC = 0xD317B10C
+
+    def save(self, stream: Stream) -> None:
+        block = self.to_block()
+        ser.write_uint32(stream, self._PAGE_MAGIC)
+        flags = (
+            (1 if block.value is not None else 0)
+            | (2 if block.weight is not None else 0)
+            | (4 if block.qid is not None else 0)
+            | (8 if block.field is not None else 0)
+        )
+        ser.write_uint32(stream, flags)
+        ser.write_uint64(stream, self.max_index)
+        ser.write_ndarray(stream, block.offset)
+        ser.write_ndarray(stream, block.label)
+        ser.write_ndarray(stream, block.index)
+        for arr in (block.value, block.weight, block.qid, block.field):
+            if arr is not None:
+                ser.write_ndarray(stream, arr)
+
+    def load(self, stream: Stream) -> bool:
+        """Load one page; returns False on clean EOF."""
+        head = stream.read(4)
+        if len(head) == 0:
+            return False
+        CHECK_EQ(len(head), 4, "RowBlockContainer: truncated page header")
+        magic = int.from_bytes(head, "little")
+        CHECK_EQ(magic, self._PAGE_MAGIC, "RowBlockContainer: bad page magic")
+        flags = ser.read_uint32(stream)
+        max_index = ser.read_uint64(stream)
+        offset = ser.read_ndarray(stream)
+        label = ser.read_ndarray(stream)
+        index = ser.read_ndarray(stream)
+        value = ser.read_ndarray(stream) if flags & 1 else None
+        weight = ser.read_ndarray(stream) if flags & 2 else None
+        qid = ser.read_ndarray(stream) if flags & 4 else None
+        field = ser.read_ndarray(stream) if flags & 8 else None
+        self.clear()
+        self.push_block(
+            RowBlock(offset, label, index, value=value, weight=weight, qid=qid, field=field)
+        )
+        self.max_index = int(max_index)
+        return True

@@ -587,12 +587,29 @@ class ThreadedInputSplit(InputSplit):
 
     def __init__(self, base: InputSplitBase, max_capacity: int = 8):
         self._base = base
-        self._iter: ThreadedIter = ThreadedIter(max_capacity=max_capacity)
-        self._iter.init(lambda _cell: self._base.next_chunk(), self._base.before_first)
+        self._max_capacity = max_capacity
+        # lazy start: prefetching before the consumer's first read would
+        # lock in chunk size before hint_chunk_size() can land
+        self._iter: Optional[ThreadedIter] = None
+        self._closed = False
         self._pending: _deque = _deque()
 
+    def _ensure_started(self) -> Optional[ThreadedIter]:
+        if self._closed:
+            return None
+        if self._iter is None:
+            self._iter = ThreadedIter(max_capacity=self._max_capacity)
+            self._iter.init(lambda _cell: self._base.next_chunk(), self._base.before_first)
+        return self._iter
+
+    def _stop(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+            self._iter = None
+
     def next_chunk(self) -> Optional[bytes]:
-        return self._iter.next()
+        it = self._ensure_started()
+        return None if it is None else it.next()
 
     def next_record(self) -> Optional[bytes]:
         while not self._pending:
@@ -604,20 +621,20 @@ class ThreadedInputSplit(InputSplit):
 
     def before_first(self) -> None:
         self._pending = _deque()
-        self._iter.before_first()
+        if self._iter is not None:
+            self._iter.before_first()
 
     def reset_partition(self, part: int, nparts: int) -> None:
-        self._iter.destroy()
+        self._stop()
         self._base.reset_partition(part, nparts)
-        self._iter = ThreadedIter(max_capacity=self._iter.max_capacity)
-        self._iter.init(lambda _cell: self._base.next_chunk(), self._base.before_first)
         self._pending = _deque()
 
     def hint_chunk_size(self, nbytes: int) -> None:
         self._base.hint_chunk_size(nbytes)
 
     def close(self) -> None:
-        self._iter.destroy()
+        self._closed = True
+        self._stop()
         self._base.close()
 
 

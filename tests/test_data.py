@@ -1,0 +1,277 @@
+"""Tests for the data layer: RowBlock CSR, parsers (native + python paths),
+row iterators.  Mirrors the reference's unittest_parser and the agaricus
+smoke config (BASELINE.md config 0)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base.logging import Error
+from dmlc_core_tpu.data import Parser, RowBlock, RowBlockContainer, RowBlockIter
+from dmlc_core_tpu.data import _native
+from dmlc_core_tpu.data.parsers import parse_uri_spec
+from dmlc_core_tpu.io import MemoryStringStream, TemporaryDirectory
+
+
+def make_block():
+    # rows: [0: (1, {0:1.0, 3:2.5}), 1: (0, {}), 2: (1, {1:-1})]
+    return RowBlock(
+        offset=[0, 2, 2, 3],
+        label=[1, 0, 1],
+        index=[0, 3, 1],
+        value=[1.0, 2.5, -1.0],
+    )
+
+
+class TestRowBlock:
+    def test_basic_shape(self):
+        b = make_block()
+        assert b.size == 3 and b.nnz == 3 and b.max_index == 3
+
+    def test_row_view_and_sdot(self):
+        b = make_block()
+        r0 = b[0]
+        assert r0.label == 1.0 and list(r0.index) == [0, 3]
+        w = np.array([1.0, 10.0, 100.0, 1000.0], np.float32)
+        assert r0.sdot(w) == pytest.approx(1.0 * 1 + 2.5 * 1000)
+        assert b[1].sdot(w) == 0.0
+
+    def test_value_none_means_ones(self):
+        b = RowBlock(offset=[0, 2], label=[1], index=[1, 2])
+        w = np.array([5.0, 7.0, 9.0], np.float32)
+        assert b[0].sdot(w) == pytest.approx(16.0)
+
+    def test_slice_zero_copy_offsets(self):
+        b = make_block()
+        s = b.slice(1, 3)
+        assert s.size == 2 and s.nnz == 1
+        assert list(s.offset) == [0, 0, 1]
+        assert s[1].index.tolist() == [1]
+
+    def test_to_dense(self):
+        d = make_block().to_dense()
+        expected = np.zeros((3, 4), np.float32)
+        expected[0, 0], expected[0, 3], expected[2, 1] = 1.0, 2.5, -1.0
+        np.testing.assert_array_equal(d, expected)
+
+    def test_shape_validation(self):
+        with pytest.raises(Error):
+            RowBlock(offset=[0, 5], label=[1], index=[1, 2])
+
+
+class TestRowBlockContainer:
+    def test_push_and_to_block(self):
+        c = RowBlockContainer()
+        c.push(1.0, [0, 2], [1.0, 3.0])
+        c.push(0.0, [], None)
+        c.push(2.0, [5], [7.0], weight=0.5, qid=3)
+        b = c.to_block()
+        assert b.size == 3 and b.nnz == 3
+        assert c.max_index == 5
+        assert b.weight is not None and b.weight[2] == 0.5
+        assert b.qid is not None and b.qid[2] == 3
+
+    def test_save_load_round_trip(self):
+        c = RowBlockContainer()
+        rng = np.random.default_rng(1)
+        for _ in range(50):
+            n = int(rng.integers(0, 6))
+            c.push(float(rng.normal()), rng.integers(0, 100, n), rng.normal(size=n))
+        s = MemoryStringStream()
+        c.save(s)
+        s.seek(0)
+        c2 = RowBlockContainer()
+        assert c2.load(s)
+        b1, b2 = c.to_block(), c2.to_block()
+        np.testing.assert_array_equal(b1.offset, b2.offset)
+        np.testing.assert_allclose(b1.label, b2.label)
+        np.testing.assert_array_equal(b1.index, b2.index)
+        np.testing.assert_allclose(b1.value, b2.value, rtol=1e-6)
+        assert c2.max_index == c.max_index
+        assert not c2.load(s)  # clean EOF
+
+    def test_multi_page_stream(self):
+        s = MemoryStringStream()
+        for page in range(3):
+            c = RowBlockContainer()
+            c.push(float(page), [page], [1.0])
+            c.save(s)
+        s.seek(0)
+        labels = []
+        c = RowBlockContainer()
+        while c.load(s):
+            labels.append(float(c.to_block().label[0]))
+        assert labels == [0.0, 1.0, 2.0]
+
+
+AGARICUS = """1 3:1 9:1 19:1
+0 1:0.5 13:1 27:1
+0 3:1 7:1
+1 9:1 19:2.5 101:1
+"""
+
+CSV_DATA = """1,0.5,2.25,3
+0,1.5,0,4
+1,0,0,5.5
+"""
+
+LIBFM = """1 0:3:1 1:9:0.5
+0 0:1:1 2:7:2
+"""
+
+
+def test_parse_uri_spec():
+    path, args, cache = parse_uri_spec("/a/b.csv?format=csv&label_column=2#/tmp/c.bin")
+    assert path == "/a/b.csv" and args == {"format": "csv", "label_column": "2"}
+    assert cache == "/tmp/c.bin"
+    path, args, cache = parse_uri_spec("/plain/file")
+    assert path == "/plain/file" and args == {} and cache is None
+
+
+@pytest.fixture(params=["native", "python"])
+def parse_mode(request, monkeypatch):
+    if request.param == "native":
+        if not _native.native_available():
+            pytest.skip("native library not built")
+    else:
+        monkeypatch.setattr(_native, "native_available", lambda: False)
+    return request.param
+
+
+class TestParsers:
+    def test_libsvm(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "a.libsvm")
+            with open(path, "w") as f:
+                f.write(AGARICUS)
+            blocks = list(Parser.create(path, format="libsvm"))
+            b = blocks[0] if len(blocks) == 1 else None
+            assert b is not None
+            assert b.size == 4
+            np.testing.assert_allclose(b.label, [1, 0, 0, 1])
+            assert b[0].index.tolist() == [3, 9, 19]
+            assert b[1].value.tolist() == [0.5, 1.0, 1.0]
+            assert b[3].value.tolist() == [1.0, 2.5, 1.0]
+            assert b.max_index == 101
+
+    def test_libsvm_qid(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "q.libsvm")
+            with open(path, "w") as f:
+                f.write("1 qid:7 1:1\n0 qid:8 2:1\n")
+            b = next(iter(Parser.create(path, format="libsvm")))
+            assert b.qid is not None and b.qid.tolist() == [7, 8]
+
+    def test_csv(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "d.csv")
+            with open(path, "w") as f:
+                f.write(CSV_DATA)
+            b = next(iter(Parser.create(path + "?format=csv")))
+            assert b.size == 3
+            np.testing.assert_allclose(b.label, [1, 0, 1])
+            # 3 feature columns, zeros kept
+            assert b.nnz == 9
+            np.testing.assert_allclose(b[0].value, [0.5, 2.25, 3.0])
+            assert b[2].index.tolist() == [0, 1, 2]
+
+    def test_csv_label_weight_columns(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "d.csv")
+            with open(path, "w") as f:
+                f.write("5,1,0.25\n6,0,0.75\n")
+            b = next(iter(Parser.create(path + "?format=csv&label_column=1&weight_column=2")))
+            np.testing.assert_allclose(b.label, [1, 0])
+            np.testing.assert_allclose(b.weight, [0.25, 0.75])
+            np.testing.assert_allclose(b[0].value, [5.0])
+
+    def test_libfm(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "d.libfm")
+            with open(path, "w") as f:
+                f.write(LIBFM)
+            b = next(iter(Parser.create(path, format="libfm")))
+            assert b.field is not None
+            assert b.field.tolist() == [0, 1, 0, 2]
+            assert b.index.tolist() == [3, 9, 1, 7]
+            np.testing.assert_allclose(b.value, [1, 0.5, 1, 2])
+
+    def test_parse_error_surfaces(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "bad.libsvm")
+            with open(path, "w") as f:
+                f.write("notanumber 1:1\n")
+            with pytest.raises(Error):
+                list(Parser.create(path, format="libsvm"))
+
+    def test_sharded_parse_coverage(self, parse_mode):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "big.libsvm")
+            with open(path, "w") as f:
+                for i in range(500):
+                    f.write(f"{i % 2} {i % 50}:{i * 0.5} {50 + i % 30}:1\n")
+            labels = []
+            for part in range(4):
+                for block in Parser.create(path, part, 4, "libsvm"):
+                    labels.extend(block.label.tolist())
+            assert len(labels) == 500
+
+    def test_native_matches_python(self):
+        if not _native.native_available():
+            pytest.skip("native library not built")
+        from dmlc_core_tpu.data.parsers import _py_parse_libsvm
+
+        chunk = AGARICUS.encode()
+        a = _native.parse_libsvm(chunk)
+        b = _py_parse_libsvm(chunk)
+        np.testing.assert_array_equal(a["offset"], b["offset"])
+        np.testing.assert_allclose(a["label"], b["label"])
+        np.testing.assert_array_equal(a["index"], b["index"])
+        np.testing.assert_allclose(a["value"], b["value"])
+
+
+class TestRowBlockIter:
+    def _write_libsvm(self, path, n=200):
+        with open(path, "w") as f:
+            for i in range(n):
+                f.write(f"{i % 2} {i % 10}:1 {10 + i % 5}:{i * 0.25}\n")
+
+    def test_basic_iter(self):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "d.libsvm")
+            self._write_libsvm(path)
+            it = RowBlockIter.create(path, format="libsvm")
+            blocks = list(it)
+            assert sum(b.size for b in blocks) == 200
+            assert it.num_col == 15
+            # rewind works
+            assert sum(b.size for b in it) == 200
+
+    def test_disk_iter_pages_and_rewind(self):
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "d.libsvm")
+            self._write_libsvm(path, n=300)
+            cache = os.path.join(tmp.path, "cache.bin")
+            it = RowBlockIter.create(f"{path}#{cache}", format="libsvm")
+            # force small pages for multi-page coverage
+            assert os.path.exists(cache)
+            total1 = sum(b.size for b in it)
+            total2 = sum(b.size for b in it)
+            assert total1 == total2 == 300
+            assert it.num_col == 15
+            it.close()
+
+    def test_disk_iter_multi_page(self):
+        from dmlc_core_tpu.data.iter import DiskRowIter
+
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "d.libsvm")
+            self._write_libsvm(path, n=500)
+            cache = os.path.join(tmp.path, "c.bin")
+            parser = Parser.create(path, format="libsvm")
+            parser.hint_chunk_size(4096)
+            it = DiskRowIter(parser, cache, page_bytes=1024)
+            assert it._num_pages > 1
+            assert sum(b.size for b in it) == 500
+            it.close()
