@@ -73,17 +73,24 @@ inline const char* skip_ws(const char* p, const char* end) {
 }
 
 inline bool parse_f32(const char*& p, const char* end, float* v) {
-  auto res = std::from_chars(p, end, *v);
+  // from_chars rejects a leading '+', but "+1" labels are canonical LibSVM
+  const char* q = (p < end && *p == '+') ? p + 1 : p;
+  auto res = std::from_chars(q, end, *v);
   if (res.ec != std::errc()) return false;
   p = res.ptr;
   return true;
 }
 
 inline bool parse_i64(const char*& p, const char* end, int64_t* v) {
-  auto res = std::from_chars(p, end, *v);
+  const char* q = (p < end && *p == '+') ? p + 1 : p;
+  auto res = std::from_chars(q, end, *v);
   if (res.ec != std::errc()) return false;
   p = res.ptr;
   return true;
+}
+
+inline bool at_token_end(const char* p, const char* end) {
+  return p >= end || *p == ' ' || *p == '\t' || *p == '\r';
 }
 
 // Split [data, data+len) into nthread ranges aligned on '\n'.
@@ -143,7 +150,8 @@ bool parse_libsvm_range(const char* begin, const char* end, ThreadRows* tr) {
           float val = 1.0f;
           if (q < line_end && *q == ':') {
             ++q;
-            if (!parse_f32(q, line_end, &val)) {
+            // "idx:" with empty value means 1.0 (matches python fallback)
+            if (!at_token_end(q, line_end) && !parse_f32(q, line_end, &val)) {
               tr->error = "libsvm: bad feature value";
               return false;
             }

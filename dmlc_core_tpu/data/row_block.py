@@ -172,6 +172,10 @@ class RowBlockContainer(Serializable):
         self._field_chunks: List[Optional[np.ndarray]] = []
         self._nnz = 0
         self.max_index = 0
+        # column presence is schema, not data: a weight column of all 1.0s
+        # must survive the cache round trip as a present column
+        self._has_weight = False
+        self._has_qid = False
 
     @property
     def size(self) -> int:
@@ -185,11 +189,15 @@ class RowBlockContainer(Serializable):
         label: float,
         index: Sequence[int],
         value: Optional[Sequence[float]] = None,
-        weight: float = 1.0,
-        qid: int = 0,
+        weight: Optional[float] = None,
+        qid: Optional[int] = None,
         field: Optional[Sequence[int]] = None,
     ) -> None:
-        """Append one row.  Reference: ``RowBlockContainer::Push(Row)``."""
+        """Append one row.  Reference: ``RowBlockContainer::Push(Row)``.
+
+        ``weight``/``qid`` of None mean "column absent" (defaults 1.0 / 0
+        are substituted if other rows establish the column).
+        """
         idx = np.asarray(index, dtype=np.int64)
         self._index_chunks.append(idx)
         self._value_chunks.append(
@@ -201,8 +209,10 @@ class RowBlockContainer(Serializable):
         self._nnz += len(idx)
         self._offsets.append(self._nnz)
         self._labels.append(float(label))
-        self._weights.append(float(weight))
-        self._qids.append(int(qid))
+        self._weights.append(1.0 if weight is None else float(weight))
+        self._qids.append(0 if qid is None else int(qid))
+        self._has_weight |= weight is not None
+        self._has_qid |= qid is not None
         if len(idx):
             self.max_index = max(self.max_index, int(idx.max()))
 
@@ -219,6 +229,8 @@ class RowBlockContainer(Serializable):
         self._weights.extend(w.tolist())
         q = block.qid if block.qid is not None else np.zeros(block.size, np.int64)
         self._qids.extend(q.tolist())
+        self._has_weight |= block.weight is not None
+        self._has_qid |= block.qid is not None
         if block.nnz:
             self.max_index = max(self.max_index, block.max_index)
 
@@ -248,15 +260,13 @@ class RowBlockContainer(Serializable):
                     for f, i in zip(self._field_chunks, self._index_chunks)
                 ]
             )
-        weights = np.asarray(self._weights, dtype=np.float32)
-        qids = np.asarray(self._qids, dtype=np.int64)
         return RowBlock(
             offset=np.asarray(self._offsets, dtype=np.int64),
             label=np.asarray(self._labels, dtype=np.float32),
             index=index,
             value=value,
-            weight=None if np.all(weights == 1.0) else weights,
-            qid=None if np.all(qids == 0) else qids,
+            weight=np.asarray(self._weights, np.float32) if self._has_weight else None,
+            qid=np.asarray(self._qids, np.int64) if self._has_qid else None,
             field=field,
         )
 

@@ -123,7 +123,10 @@ class FileSystem:
             # glob on the basename, matched against this backend's own
             # listing (never the OS filesystem — backends own their namespace)
             parent, _, pattern = name.rpartition("/")
-            parent_uri = URI(uri.protocol + uri.host + (parent or "/"))
+            if not parent:
+                # '/x*' → root; bare relative 'x*' → current directory
+                parent = "/" if name.startswith("/") else "."
+            parent_uri = URI(uri.protocol + uri.host + parent)
             out = [
                 f
                 for f in self.list_directory(parent_uri)

@@ -175,7 +175,6 @@ class InputSplitBase(InputSplit):
     def before_first(self) -> None:
         self._pos = self._begin
         self._carry = b""
-        self._carry_fidx = -1
         self._pending: _deque = _deque()
 
     def hint_chunk_size(self, nbytes: int) -> None:
@@ -234,33 +233,28 @@ class InputSplitBase(InputSplit):
     def next_chunk(self) -> Optional[bytes]:
         """Next blob of complete records (None at end of this part's range).
 
-        Reads never pass ``self._end``; a record that *starts* in-range but
-        continues past the boundary is completed by :meth:`_finish_tail`
-        (upstream semantics: a record is owned by the part where it starts).
+        Invariant: ``_begin`` and ``_end`` are both record boundaries (same
+        ``_align_global``), so ranges tile exactly and a read stopping at
+        ``_end`` always lands on a record end — a leftover there means
+        corrupt input.  The carry only bridges chunk reads *within* a file
+        (file ends are record ends; ``_extract(…, at_eof=True)`` flushes).
         """
         while True:
             if self._pos >= self._end:
+                if self._carry:
+                    log_fatal("InputSplit: partial record at aligned range end "
+                              "(corrupt input?)")
                 return None
             fidx = self._find_file(self._pos)
-            if self._carry_fidx not in (-1, fidx) and self._carry:
-                # file boundary: flush previous file's tail as a record end
-                recs, rem = self._extract(self._carry, True)
-                self._carry = b""
-                if rem:
-                    log_fatal("InputSplit: record spans file boundary")
-                if recs:
-                    return self._join(recs)
             want = min(self._chunk_size, self._end - self._pos)
             data = self._read_at(self._pos, want)
             if not data:
                 log_fatal("InputSplit: short read inside assigned range")
             self._pos += len(data)
-            if self._carry_fidx == fidx and self._carry:
+            if self._carry:
                 data = self._carry + data
-            self._carry = b""
-            file_end = self._cum[fidx + 1]
-            at_file_end = self._pos >= file_end
-            range_end = self._pos >= self._end
+                self._carry = b""
+            at_file_end = self._pos >= self._cum[fidx + 1]
             recs, rem = self._extract(data, at_file_end)
             if rem:
                 if at_file_end:
@@ -268,43 +262,9 @@ class InputSplitBase(InputSplit):
                         f"InputSplit: incomplete record at end of file "
                         f"{self._files[fidx].path!r} (is it the right format?)"
                     )
-                if range_end:
-                    tail = self._finish_tail(rem, fidx, file_end)
-                    if tail is not None:
-                        recs.append(tail)
-                else:
-                    self._carry = rem
-                    self._carry_fidx = fidx
+                self._carry = rem
             if recs:
                 return self._join(recs)
-            if self._pos >= self._end and not self._carry:
-                return None
-
-    def _finish_tail(self, rem: bytes, fidx: int, file_end: int) -> Optional[bytes]:
-        """Complete the single record in ``rem`` that crosses ``self._end``:
-        read past the boundary (within this file) until the first record
-        boundary, returning exactly that record's bytes.  Bytes after it
-        belong to the next part and are discarded."""
-        while True:
-            end_off = self._first_record_end(rem)
-            if end_off is not None:
-                return rem[:end_off]
-            if self._pos >= file_end:
-                # file ended without a terminator: rem is the final record
-                recs, leftover = self._extract(rem, True)
-                if leftover:
-                    log_fatal("InputSplit: incomplete record at file end")
-                return self._join(recs) if recs else None
-            data = self._read_at(self._pos, self._chunk_size)
-            if not data:
-                log_fatal("InputSplit: short read while completing tail record")
-            self._pos += len(data)
-            rem = rem + data
-
-    def _first_record_end(self, buf: bytes) -> Optional[int]:
-        """Offset just past the first complete record in ``buf`` (None if
-        the record is still incomplete)."""
-        raise NotImplementedError
 
     @staticmethod
     def _join(recs: List[bytes]) -> bytes:
@@ -358,10 +318,6 @@ class LineSplit(InputSplitBase):
         if last_nl < 0:
             return [], buf
         return [buf[: last_nl + 1]], buf[last_nl + 1 :]
-
-    def _first_record_end(self, buf: bytes) -> Optional[int]:
-        nl = buf.find(b"\n")
-        return nl + 1 if nl >= 0 else None
 
     @staticmethod
     def _join(recs: List[bytes]) -> bytes:
@@ -420,19 +376,6 @@ class RecordIOSplit(InputSplitBase):
             if cflag in (0, 3):  # record complete
                 consumed = pos
         return ([buf[:consumed]] if consumed else []), buf[consumed:]
-
-    def _first_record_end(self, buf: bytes) -> Optional[int]:
-        pos = 0
-        n = len(buf)
-        while pos + 8 <= n:
-            lrec = int.from_bytes(buf[pos + 4 : pos + 8], "little")
-            part_end = pos + 8 + (((decode_length(lrec) + 3) >> 2) << 2)
-            if part_end > n:
-                return None
-            pos = part_end
-            if decode_flag(lrec) in (0, 3):
-                return pos
-        return None
 
     @staticmethod
     def _join(recs: List[bytes]) -> bytes:
@@ -674,8 +617,12 @@ class CachedInputSplit(InputSplit):
         if self._read_stream is None:
             self._read_stream = Stream.create(self._cache_uri, "r")
         head = self._read_stream.read(8)
+        if len(head) == 0:
+            return None  # clean EOF
         if len(head) < 8:
-            return None
+            # partial length prefix = interrupted pass-1 write; read_exact
+            # fatals rather than silently truncating the epoch
+            head += self._read_stream.read_exact(8 - len(head))
         n = int.from_bytes(head, "little")
         return self._read_stream.read_exact(n)
 

@@ -1,0 +1,30 @@
+"""Distributed layer (L7): named device meshes, rabit-shaped collectives
+over XLA (ICI/DCN), KVStore shim, sharded checkpointing.
+
+Reference parity: the tracker-coordinated rabit protocol (tree allreduce +
+ring allgather over raw TCP, topology from ``tracker.py``) and the ps-lite
+bootstrap (SURVEY.md §2c, §5).  Re-founded: collectives are XLA ops
+(``psum``/``all_gather``/``ppermute``) on a GSPMD mesh — the "engine" is the
+TPU interconnect itself, coordination collapses onto
+``jax.distributed.initialize``, and the tracker survives as the launch/ABI
+layer (``dmlc_core_tpu.tracker``).
+"""
+
+from dmlc_core_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    create_mesh,
+    data_sharding,
+    replicated_sharding,
+)
+from dmlc_core_tpu.parallel.collectives import (  # noqa: F401
+    init,
+    finalize,
+    rank,
+    world_size,
+    is_distributed,
+    allreduce,
+    broadcast,
+    allgather,
+    barrier,
+)
+from dmlc_core_tpu.parallel.kvstore import KVStore  # noqa: F401

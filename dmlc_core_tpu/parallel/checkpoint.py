@@ -1,0 +1,111 @@
+"""Sharded checkpoint / resume of jax pytrees over any Stream URI.
+
+Reference parity: dmlc-core provides checkpoint *mechanism*, not policy —
+``Stream``/``Serializable`` binary round-trip to any URI, which rabit's
+``CheckPoint()/LoadCheckPoint()`` and XGBoost model I/O build on
+(SURVEY.md §5).  Here the same layering carries jax state:
+
+* ``save(uri, pytree)`` — host-gathers each leaf (or saves only this
+  process's addressable shards in per-rank files when ``sharded=True``)
+  and serializes through the Stream layer, so checkpoints inherit every
+  filesystem backend (local/mem://, later object stores) for free.
+* rabit parity: ``version_number`` round-trips with the state, and
+  ``load_checkpoint`` returns ``(version, state)`` with version 0 when no
+  checkpoint exists — exactly the resume-loop contract XGBoost uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.io import serializer as ser
+from dmlc_core_tpu.io.stream import Stream
+from dmlc_core_tpu.parallel import collectives as coll
+
+__all__ = ["checkpoint", "load_checkpoint"]
+
+_MAGIC = 0xC4EC7A90
+
+
+def _to_host(leaf: Any) -> Any:
+    if isinstance(leaf, jax.Array):
+        return np.asarray(leaf)
+    return leaf
+
+
+def checkpoint(uri: str, state: Any, version: int = 0, sharded: bool = False) -> None:
+    """Save a pytree of arrays/scalars.  Reference: rabit ``CheckPoint``.
+
+    ``sharded=True`` writes one file per process (``uri.shard-K-of-N``),
+    each holding only locally-addressable shard data — the multi-host path
+    where no single host can materialize the full arrays.
+    """
+    if sharded and coll.world_size() > 1:
+        uri = f"{uri}.shard-{coll.rank()}-of-{coll.world_size()}"
+        leaves, treedef = jax.tree.flatten(state)
+        host_leaves = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                shards = sorted(leaf.addressable_shards, key=lambda s: s.index)
+                host_leaves.append([(str(s.index), np.asarray(s.data)) for s in shards])
+            else:
+                host_leaves.append(_to_host(leaf))
+        payload = host_leaves
+    else:
+        if coll.world_size() > 1 and coll.rank() != 0:
+            coll.barrier("ckpt")
+            return  # replicated state: rank 0 writes
+        payload = jax.tree.map(_to_host, state)
+        payload = jax.tree.flatten(payload)[0]
+    stream = Stream.create(uri, "w")
+    ser.write_uint32(stream, _MAGIC)
+    ser.write_uint64(stream, version)
+    ser.write_obj(stream, payload)
+    stream.close()
+    if coll.world_size() > 1 and not sharded:
+        coll.barrier("ckpt")
+
+
+def load_checkpoint(uri: str, like: Any, sharded: bool = False) -> Tuple[int, Any]:
+    """Load a checkpoint into the structure of ``like``.
+
+    Returns ``(version, state)``; ``(0, like)`` when no checkpoint exists —
+    rabit's ``LoadCheckPoint`` contract for cold starts.
+    """
+    if sharded and coll.world_size() > 1:
+        uri = f"{uri}.shard-{coll.rank()}-of-{coll.world_size()}"
+    stream = Stream.create(uri, "r", allow_null=True)
+    if stream is None:
+        return 0, like
+    magic = ser.read_uint32(stream)
+    CHECK(magic == _MAGIC, "checkpoint: bad magic")
+    version = ser.read_uint64(stream)
+    payload = ser.read_obj(stream)
+    stream.close()
+    leaves, treedef = jax.tree.flatten(like)
+    CHECK(len(payload) == len(leaves), "checkpoint: leaf count mismatch")
+    out_leaves = []
+    for saved, ref in zip(payload, leaves):
+        if isinstance(saved, list) and saved and isinstance(saved[0], tuple):
+            # sharded leaf: reassemble only this process's shards into the
+            # reference sharding via device_put per shard
+            CHECK(isinstance(ref, jax.Array), "checkpoint: sharded leaf vs non-array ref")
+            arrays = {idx: data for idx, data in saved}
+            shards = []
+            for s in sorted(ref.addressable_shards, key=lambda s: s.index):
+                data = arrays.get(str(s.index))
+                CHECK(data is not None, "checkpoint: missing shard")
+                shards.append(jax.device_put(data, s.device))
+            out_leaves.append(
+                jax.make_array_from_single_device_arrays(ref.shape, ref.sharding, shards)
+            )
+        elif isinstance(ref, jax.Array):
+            out_leaves.append(jax.device_put(np.asarray(saved), ref.sharding))
+        else:
+            out_leaves.append(saved)
+    return int(version), jax.tree.unflatten(treedef, out_leaves)

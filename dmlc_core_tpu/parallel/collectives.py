@@ -1,0 +1,279 @@
+"""Rabit-shaped collectives, re-founded on XLA.
+
+Reference parity: the worker-side rabit API (``Allreduce<op>``,
+``Broadcast``, ``rank``/``world_size``, ``CheckPoint``) that dmlc-core's
+tracker coordinates, plus the tracker's topology math
+(``tracker/dmlc_tracker/tracker.py :: get_tree / find_share_ring /
+get_link_map`` — SURVEY.md §2c).
+
+Engine replacement (the north star): there are no sockets here.
+
+* **In-jit path (the fast path)**: ``device_allreduce`` /
+  ``device_allgather`` are ``shard_map``-based XLA collectives on a named
+  mesh — histogram sync, gradient sync, anything inside a train step rides
+  ICI/DCN with XLA-scheduled overlap.  This is what the hist-GBT flagship
+  and the KVStore shim compile onto.
+* **Host path (rabit API parity)**: ``allreduce(np_array)`` etc. work on
+  host values *between* steps, across processes, via the JAX runtime's
+  global device set.  Coordination (rank assignment, liveness) is
+  ``jax.distributed`` — bootstrapped from the ``DMLC_*`` env ABI by
+  :func:`init`, keeping the reference's launch contract intact.
+
+Topology functions are retained because (a) the tracker still serves them
+to non-JAX legacy workers, and (b) they are the oracle for our tests'
+parity with the reference's coordination brain.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
+from dmlc_core_tpu.base.parameter import get_env
+
+__all__ = [
+    "init", "finalize", "rank", "world_size", "is_distributed",
+    "allreduce", "broadcast", "allgather", "barrier",
+    "device_allreduce", "device_allgather",
+    "get_tree", "find_share_ring", "get_link_map",
+]
+
+_initialized = False
+
+_REDUCERS = {
+    "sum": np.add.reduce,
+    "max": np.maximum.reduce,
+    "min": np.minimum.reduce,
+    "prod": np.multiply.reduce,
+    "bitor": np.bitwise_or.reduce,
+}
+
+
+# ---------------------------------------------------------------------------
+# bootstrap: DMLC_* env ABI → jax.distributed
+# ---------------------------------------------------------------------------
+
+def init(args: Optional[Dict[str, str]] = None) -> None:
+    """Initialize distributed state from the ``DMLC_*`` env ABI.
+
+    Reference parity: rabit's ``Init(argc, argv)`` reading
+    ``DMLC_TRACKER_URI``/``DMLC_TRACKER_PORT``/``DMLC_TASK_ID``/
+    ``DMLC_NUM_WORKER`` (SURVEY.md §2c env-var ABI).  Here those map onto
+    ``jax.distributed.initialize(coordinator, num_processes, process_id)``
+    — the JAX coordination service replaces the rabit tracker protocol.
+
+    Single-process (no env set) is a no-op: everything below degrades to
+    identity collectives, so the same program runs 1-chip or pod-scale.
+    """
+    global _initialized
+    if _initialized:
+        return
+    env = dict(os.environ)
+    if args:
+        env.update(args)
+    nworker = int(env.get("DMLC_NUM_WORKER", "1"))
+    if nworker <= 1:
+        _initialized = True
+        return
+    uri = env.get("DMLC_TRACKER_URI")
+    port = env.get("DMLC_TRACKER_PORT", "9091")
+    task_id = int(env.get("DMLC_TASK_ID", "0"))
+    CHECK(uri is not None, "DMLC_NUM_WORKER > 1 but DMLC_TRACKER_URI unset")
+    jax.distributed.initialize(
+        coordinator_address=f"{uri}:{port}",
+        num_processes=nworker,
+        process_id=task_id,
+    )
+    _initialized = True
+    LOG("INFO", "dmlc collectives: process %d/%d online", task_id, nworker)
+
+
+def finalize() -> None:
+    """Reference parity: rabit ``Finalize()``."""
+    global _initialized
+    if _initialized and jax.process_count() > 1:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _initialized = False
+
+
+def rank() -> int:
+    """This worker's rank.  Reference: rabit ``GetRank`` = process index."""
+    return jax.process_index()
+
+
+def world_size() -> int:
+    """Number of workers.  Reference: rabit ``GetWorldSize``."""
+    return jax.process_count()
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+# ---------------------------------------------------------------------------
+# host-level collectives (rabit API parity, between-step granularity)
+# ---------------------------------------------------------------------------
+
+def allreduce(x: np.ndarray, op: str = "sum") -> np.ndarray:
+    """Allreduce a host array across processes.
+
+    Reference parity: rabit ``Allreduce<op>(ptr, count)``.  Implemented as
+    process-allgather + local reduce through the JAX runtime (exact for
+    every op incl. non-commutative-sensitive float sums: every rank reduces
+    in the same rank order, so results are bitwise identical across
+    workers — the determinism rabit guaranteed via its fixed tree).
+    For in-step sync use :func:`device_allreduce`, which stays on ICI.
+    """
+    x = np.asarray(x)
+    if op not in _REDUCERS:
+        log_fatal(f"allreduce: unknown op {op!r}; valid: {sorted(_REDUCERS)}")
+    if world_size() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(x, tiled=False)  # [world, ...]
+    return _REDUCERS[op](np.asarray(gathered), axis=0)
+
+
+def broadcast(x: Any, root: int = 0) -> Any:
+    """Broadcast a host value from ``root``.  Reference: rabit ``Broadcast``."""
+    if world_size() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x, is_source=rank() == root)
+
+
+def allgather(x: np.ndarray) -> np.ndarray:
+    """Gather arrays from all processes, stacked on axis 0 in rank order."""
+    x = np.asarray(x)
+    if world_size() == 1:
+        return x[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=False))
+
+
+def barrier(name: str = "dmlc") -> None:
+    """Cross-process barrier (rabit's implicit sync points, made explicit)."""
+    if world_size() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+# ---------------------------------------------------------------------------
+# in-jit collectives (the TPU fast path)
+# ---------------------------------------------------------------------------
+
+_LAX_REDUCE = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+}
+
+
+def device_allreduce(x: jax.Array, mesh: Mesh, op: str = "sum",
+                     axis: str = "data") -> jax.Array:
+    """Allreduce per-device shards over a mesh axis, on-device.
+
+    ``x`` is sharded on ``axis`` along dim 0 (one shard per device); the
+    result is the reduced array, replicated.  Lowers to a single XLA
+    AllReduce riding ICI — this is the histogram-sync primitive
+    (north star: replaces rabit's socket tree allreduce).
+
+    Composable: call inside your own jit/shard_map too — this helper is
+    just the standalone spelling.
+    """
+    if op not in _LAX_REDUCE:
+        log_fatal(f"device_allreduce: unknown op {op!r}")
+    lax_op = _LAX_REDUCE[op]
+    local_op = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def _reduce(shard):
+        return lax_op(local_op(shard, axis=0), axis)
+
+    return jax.jit(_reduce)(x)
+
+
+def device_allgather(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
+    """All-gather shards over a mesh axis (XLA AllGather on ICI)."""
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
+    def _gather(shard):
+        return jax.lax.all_gather(shard, axis, tiled=True)
+
+    return jax.jit(_gather)(x)
+
+
+# ---------------------------------------------------------------------------
+# topology math (tracker parity; oracle-tested)
+# ---------------------------------------------------------------------------
+
+def get_tree(n: int) -> Tuple[Dict[int, int], Dict[int, List[int]]]:
+    """Binary reduction tree over ranks 0..n-1.
+
+    Reference parity: ``tracker.py :: get_tree`` — parent(r) = (r-1)//2.
+    Returns (parent_map, children_map); root's parent is -1.
+    """
+    parent: Dict[int, int] = {0: -1}
+    children: Dict[int, List[int]] = {r: [] for r in range(n)}
+    for r in range(1, n):
+        p = (r - 1) // 2
+        parent[r] = p
+        children[p].append(r)
+    return parent, children
+
+
+def find_share_ring(children: Dict[int, List[int]], root: int = 0) -> List[int]:
+    """Ring order as a depth-first traversal of the tree.
+
+    Reference parity: ``tracker.py :: find_share_ring`` — DFS of the
+    reduction tree yields a ring where every hop is also a tree edge or
+    close to one, so the two topologies share physical links.
+    """
+    order: List[int] = []
+
+    def dfs(r: int) -> None:
+        order.append(r)
+        for c in children[r]:
+            dfs(c)
+
+    dfs(root)
+    return order
+
+
+def get_link_map(n: int) -> Dict[int, Dict[str, Any]]:
+    """Per-rank connection map: tree parent/children + ring prev/next.
+
+    Reference parity: ``tracker.py :: get_link_map`` — this is the payload
+    the tracker sends each worker at 'start'.
+    """
+    parent, children = get_tree(n)
+    ring = find_share_ring(children)
+    pos = {r: i for i, r in enumerate(ring)}
+    out: Dict[int, Dict[str, Any]] = {}
+    for r in range(n):
+        i = pos[r]
+        out[r] = {
+            "parent": parent[r],
+            "children": list(children[r]),
+            "ring_prev": ring[(i - 1) % n],
+            "ring_next": ring[(i + 1) % n],
+        }
+    return out

@@ -1,0 +1,118 @@
+"""MXNet-KVStore-shaped API over XLA collectives.
+
+Reference parity: dmlc-core bootstraps ps-lite's parameter server
+(``PSTracker`` env ABI: ``DMLC_PS_ROOT_URI/PORT``, ``DMLC_ROLE`` —
+SURVEY.md §2c); the KVStore itself lived in MXNet/ps-lite.  This module
+provides the consumer-facing surface (``init/push/pull``, ``dist_sync``)
+so KVStore-based training loops port unchanged — but there are no servers:
+
+* ``local``: single-process store (values live as jax.Arrays on device).
+* ``dist_sync``: push accumulates local gradients; pull returns the value
+  after a cross-worker allreduce of pending gradients and an optimizer
+  update — the parameter-server round-trip collapsed onto one XLA
+  AllReduce over ICI/DCN (the north-star replacement of PS/NCCL traffic;
+  BASELINE config 4).
+
+For gradient sync *inside* a jitted train step, use
+``collectives.device_allreduce`` / shard_map psum directly; this class is
+the between-step host API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.parallel import collectives as coll
+
+__all__ = ["KVStore"]
+
+Key = Union[int, str]
+
+
+class KVStore:
+    """``KVStore.create("local" | "dist_sync")`` — init/push/pull.
+
+    The optimizer hook (``set_updater``) matches MXNet's contract:
+    ``updater(key, grad, value) -> new_value``; default is SGD with
+    ``learning_rate`` (so push/pull alone implements dist-SGD).
+    """
+
+    def __init__(self, kv_type: str = "local", learning_rate: float = 0.1):
+        CHECK(kv_type in ("local", "dist_sync"), f"unknown kvstore type {kv_type!r}")
+        self.type = kv_type
+        self._store: Dict[Key, jax.Array] = {}
+        self._pending: Dict[Key, jax.Array] = {}
+        self._lr = learning_rate
+        self._updater: Callable[[Key, jax.Array, jax.Array], jax.Array] = (
+            lambda key, grad, value: value - self._lr * grad
+        )
+
+    @staticmethod
+    def create(kv_type: str = "local", **kw: Any) -> "KVStore":
+        return KVStore(kv_type, **kw)
+
+    # -- MXNet KVStore surface -------------------------------------------
+    def init(self, keys: Union[Key, Sequence[Key]], values: Any) -> None:
+        """Register initial values.  In dist_sync mode rank 0's value wins
+        (broadcast), matching KVStore semantics."""
+        keys, values = self._normalize(keys, values)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                log_fatal(f"KVStore.init: key {k!r} already initialized")
+            v = np.asarray(v)
+            if self.type == "dist_sync":
+                v = coll.broadcast(v, root=0)
+            self._store[k] = jnp.asarray(v)
+
+    def push(self, keys: Union[Key, Sequence[Key]], grads: Any) -> None:
+        """Accumulate gradients (summed over multiple pushes per key)."""
+        keys, grads = self._normalize(keys, grads)
+        for k, g in zip(keys, grads):
+            self._check_key(k)
+            g = jnp.asarray(g)
+            self._pending[k] = self._pending[k] + g if k in self._pending else g
+
+    def pull(self, keys: Union[Key, Sequence[Key]]) -> Union[jax.Array, List[jax.Array]]:
+        """Sync pending gradients (allreduce across workers in dist_sync),
+        apply the updater, return current value(s)."""
+        single = not isinstance(keys, (list, tuple))
+        key_list: List[Key] = [keys] if single else list(keys)
+        for k in key_list:
+            self._check_key(k)
+            if k in self._pending:
+                grad = self._pending.pop(k)
+                if self.type == "dist_sync" and coll.world_size() > 1:
+                    grad = jnp.asarray(coll.allreduce(np.asarray(grad), "sum"))
+                self._store[k] = self._updater(k, grad, self._store[k])
+        out = [self._store[k] for k in key_list]
+        return out[0] if single else out
+
+    def set_updater(self, updater: Callable[[Key, jax.Array, jax.Array], jax.Array]) -> None:
+        self._updater = updater
+
+    @property
+    def rank(self) -> int:
+        return coll.rank()
+
+    @property
+    def num_workers(self) -> int:
+        return coll.world_size()
+
+    # -- helpers ---------------------------------------------------------
+    def _check_key(self, k: Key) -> None:
+        if k not in self._store:
+            log_fatal(f"KVStore: key {k!r} not initialized")
+
+    @staticmethod
+    def _normalize(keys, values):
+        if isinstance(keys, (list, tuple)):
+            CHECK(isinstance(values, (list, tuple)) and len(keys) == len(values),
+                  "KVStore: keys/values length mismatch")
+            return list(keys), list(values)
+        return [keys], [values]

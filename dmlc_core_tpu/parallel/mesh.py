@@ -1,0 +1,98 @@
+"""Named device meshes — the substrate every distributed op runs on.
+
+The reference has no mesh concept (its only strategy is data parallelism
+over rabit sockets, SURVEY.md §2e); here the mesh is first-class so the
+same substrate scales past DP without rework: axes are reserved for
+data / model (tensor) / pipe (pipeline) / seq (sequence/context, ring
+attention) / expert parallelism.  XLA lowers collectives onto ICI within a
+slice and DCN across hosts based purely on these shardings — that is the
+entire "communication backend" (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, log_fatal
+from dmlc_core_tpu.base.parameter import Parameter, field
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "create_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "local_mesh",
+]
+
+# canonical axis order; unused axes get size 1 and cost nothing
+AXES: Tuple[str, ...] = ("data", "model", "pipe", "seq", "expert")
+
+
+class MeshSpec(Parameter):
+    """Mesh shape as a Parameter (env/config/CLI-settable).
+
+    ``-1`` on exactly one axis means "all remaining devices" (like a numpy
+    reshape wildcard); the default puts every device on ``data``.
+    """
+
+    data = field(int, default=-1, description="data-parallel axis size")
+    model = field(int, default=1, description="tensor-parallel axis size")
+    pipe = field(int, default=1, description="pipeline-parallel axis size")
+    seq = field(int, default=1, description="sequence/context-parallel axis size")
+    expert = field(int, default=1, description="expert-parallel axis size")
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {ax: getattr(self, ax) for ax in AXES}
+        wild = [ax for ax, s in sizes.items() if s == -1]
+        CHECK(len(wild) <= 1, "at most one mesh axis may be -1")
+        fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+        if wild:
+            CHECK_EQ(n_devices % fixed, 0,
+                     f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        else:
+            CHECK_EQ(fixed, n_devices, f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+def create_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Sequence[str] = AXES,
+) -> Mesh:
+    """Build a named Mesh over ``devices`` (default: all global devices).
+
+    On a multi-host pod this uses the global device set — XLA routes
+    intra-slice traffic over ICI and cross-host traffic over DCN from the
+    device coordinates; nothing else to configure.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devs))
+    shape = tuple(sizes[ax] for ax in axis_names)
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def local_mesh(n: Optional[int] = None, axis: str = "data") -> Mesh:
+    """A 1-axis mesh over the first ``n`` devices (test/bench convenience)."""
+    devs = jax.devices()[: n or len(jax.devices())]
+    return Mesh(np.asarray(devs), axis_names=(axis,))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1, axis: str = "data") -> NamedSharding:
+    """Shard dim 0 on the data axis, replicate the rest — the input-batch
+    sharding for DP (the reference's ``InputSplit(part, nparts)`` byte
+    sharding, lifted to device buffers)."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (every device holds the full array)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,15 @@
+"""Distributed job launch & coordination (L7).
+
+Reference parity: ``tracker/dmlc_tracker/`` — the ``dmlc-submit`` CLI,
+cluster backends, the RabitTracker coordination service and the ``DMLC_*``
+env-var ABI (SURVEY.md §2c).
+
+TPU-world collapse: rank/topology coordination for JAX workers is the JAX
+coordination service (process 0 hosts it; ``collectives.init`` maps
+``DMLC_TRACKER_URI:PORT`` straight onto it), so the tracker here is
+(a) the launcher that exports the env ABI, and (b) a :class:`RabitTracker`
+service retained for legacy rabit-protocol workers and as the oracle-tested
+home of the tree/ring topology math.
+"""
+
+from dmlc_core_tpu.tracker.tracker import RabitTracker, PSTracker, submit  # noqa: F401

@@ -1,0 +1,57 @@
+"""Local multi-process launch backend.
+
+Reference parity: ``tracker/dmlc_tracker/local.py`` — fork N worker
+subprocesses on one machine with the env ABI injected.  This is how the
+reference "tests multi-node without a cluster" (SURVEY.md §4), and how we
+exercise ``jax.distributed`` + cross-process collectives on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, LOG
+
+__all__ = ["launch"]
+
+
+def launch(
+    nworker: int,
+    command: List[str],
+    envs: Dict[str, str],
+    extra_env: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = None,
+) -> List[int]:
+    """Run ``command`` in ``nworker`` local processes; returns exit codes.
+
+    Each worker gets the shared env ABI plus ``DMLC_TASK_ID``/
+    ``DMLC_ROLE=worker``.  Workers calling ``collectives.init()`` will form
+    a jax.distributed cluster with process 0 hosting the coordinator at
+    ``DMLC_TRACKER_URI:DMLC_TRACKER_PORT``.
+    """
+    CHECK(len(command) > 0, "local.launch: empty worker command")
+    procs = []
+    for task_id in range(nworker):
+        env = dict(os.environ)
+        env.update(envs)
+        if extra_env:
+            env.update(extra_env)
+        env["DMLC_TASK_ID"] = str(task_id)
+        env["DMLC_ROLE"] = "worker"
+        procs.append(subprocess.Popen(command, env=env))
+    codes = []
+    try:
+        for p in procs:
+            codes.append(p.wait(timeout=timeout))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    failed = [i for i, c in enumerate(codes) if c != 0]
+    if failed:
+        LOG("ERROR", "local launch: workers %s exited nonzero (%s)", failed,
+            [codes[i] for i in failed])
+    return codes

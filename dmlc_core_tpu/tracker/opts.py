@@ -1,0 +1,49 @@
+"""Launch-option surface for dmlc-submit.
+
+Reference parity: ``tracker/dmlc_tracker/opts.py :: get_opts`` — cluster
+selection, worker counts, resources, env passthrough (SURVEY.md §2c).
+Cluster backends kept: ``local`` (single machine, the test path) and
+``ssh`` (ad-hoc clusters).  YARN/SGE/Slurm/Mesos/K8s launchers from the
+reference are cluster-manager integrations orthogonal to the TPU redesign;
+on TPU pods the platform launcher (GKE/queued resources) replaces them —
+the env ABI below is what carries over.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional, Tuple
+
+__all__ = ["get_opts"]
+
+
+def get_opts(args: Optional[List[str]] = None) -> Tuple[argparse.Namespace, List[str]]:
+    parser = argparse.ArgumentParser(
+        prog="dmlc-submit",
+        description="Submit a distributed dmlc_core_tpu job",
+    )
+    parser.add_argument("--cluster", choices=["local", "ssh"], default="local",
+                        help="launch backend")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="number of server processes (PS mode)")
+    parser.add_argument("-H", "--host-file", type=str, default=None,
+                        help="file listing one host per line (ssh cluster)")
+    parser.add_argument("--host-ip", type=str, default="127.0.0.1",
+                        help="tracker/coordinator bind address")
+    parser.add_argument("--jobname", type=str, default="dmlc-job")
+    parser.add_argument("--env", action="append", default=[],
+                        help="extra KEY=VALUE env for workers (repeatable)")
+    parser.add_argument("--log-level", choices=["DEBUG", "INFO", "WARNING", "ERROR"],
+                        default="INFO")
+    parser.add_argument("--start-legacy-tracker", action="store_true",
+                        help="also run the RabitTracker TCP service for "
+                             "legacy (non-JAX) workers")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="worker command (after --)")
+    opts = parser.parse_args(args)
+    command = opts.command
+    if command and command[0] == "--":
+        command = command[1:]
+    return opts, command

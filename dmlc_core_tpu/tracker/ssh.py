@@ -1,0 +1,58 @@
+"""SSH cluster launch backend.
+
+Reference parity: ``tracker/dmlc_tracker/ssh.py`` — read a host file, start
+one worker per slot via ``ssh host 'env ... cmd'`` (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from typing import Dict, List, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, LOG
+
+__all__ = ["launch", "read_host_file"]
+
+
+def read_host_file(path: str) -> List[str]:
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line.split()[0])
+    CHECK(len(hosts) > 0, f"host file {path!r} has no hosts")
+    return hosts
+
+
+def _remote_command(command: List[str], env: Dict[str, str], cwd: str) -> str:
+    env_part = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    cmd_part = " ".join(shlex.quote(c) for c in command)
+    return f"cd {shlex.quote(cwd)} && env {env_part} {cmd_part}"
+
+
+def launch(
+    nworker: int,
+    command: List[str],
+    envs: Dict[str, str],
+    hosts: List[str],
+    cwd: Optional[str] = None,
+    ssh_binary: str = "ssh",
+) -> List[int]:
+    """Start workers round-robin over ``hosts``; wait for completion."""
+    CHECK(len(command) > 0, "ssh.launch: empty worker command")
+    cwd = cwd or os.getcwd()
+    procs = []
+    for task_id in range(nworker):
+        host = hosts[task_id % len(hosts)]
+        env = dict(envs)
+        env["DMLC_TASK_ID"] = str(task_id)
+        env["DMLC_ROLE"] = "worker"
+        remote = _remote_command(command, env, cwd)
+        LOG("INFO", "ssh worker %d → %s", task_id, host)
+        procs.append(
+            subprocess.Popen([ssh_binary, "-o", "StrictHostKeyChecking=no", host, remote])
+        )
+    return [p.wait() for p in procs]

@@ -1,0 +1,60 @@
+"""dmlc-submit CLI entry point.
+
+Reference parity: ``tracker/dmlc-submit`` → ``dmlc_tracker/submit.py``
+(SURVEY.md §2c).  Usage::
+
+    python -m dmlc_core_tpu.tracker.submit --cluster local -n 4 -- \
+        python my_worker.py
+
+Workers read the ``DMLC_*`` env ABI (``collectives.init()``) and form a
+jax.distributed cluster; on a TPU pod, run one worker per host.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, set_log_level
+from dmlc_core_tpu.tracker import local as local_backend
+from dmlc_core_tpu.tracker import ssh as ssh_backend
+from dmlc_core_tpu.tracker.opts import get_opts
+from dmlc_core_tpu.tracker.tracker import submit as tracker_submit
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    opts, command = get_opts(argv)
+    set_log_level(opts.log_level)
+    CHECK(len(command) > 0, "no worker command given (use: dmlc-submit ... -- cmd)")
+    extra_env = dict(kv.split("=", 1) for kv in opts.env)
+    exit_codes: List[int] = []
+
+    def fun_submit(n_total: int, envs) -> None:
+        envs = {**envs, **extra_env}
+        if opts.cluster == "local":
+            exit_codes.extend(
+                local_backend.launch(opts.num_workers, command, envs)
+            )
+        elif opts.cluster == "ssh":
+            CHECK(opts.host_file is not None, "--cluster ssh needs --host-file")
+            hosts = ssh_backend.read_host_file(opts.host_file)
+            exit_codes.extend(
+                ssh_backend.launch(opts.num_workers, command, envs, hosts)
+            )
+
+    tracker = tracker_submit(
+        opts.num_workers,
+        opts.num_servers,
+        fun_submit,
+        host_ip=opts.host_ip,
+        start_tracker=opts.start_legacy_tracker,
+    )
+    if tracker is not None:
+        tracker.stop()
+    return 0 if all(c == 0 for c in exit_codes) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

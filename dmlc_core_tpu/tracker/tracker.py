@@ -1,0 +1,228 @@
+"""Coordination services: RabitTracker (rank + topology) and PSTracker.
+
+Reference parity: ``tracker/dmlc_tracker/tracker.py :: RabitTracker``
+(bind TCP port; accept worker cmds start/recover/shutdown/print; assign
+ranks host-aware; send each worker num_worker, tree parent/children and
+ring prev/next, computed by get_tree/find_share_ring), ``PSTracker``
+(ps-lite role bootstrap), and ``submit()`` glue (SURVEY.md §2c).
+
+Wire protocol: newline-delimited JSON (this framework's own framing — the
+reference's binary ``ExSocket`` framing belonged to rabit's C++ client,
+which doesn't exist here).  JAX workers don't connect at all: their
+coordination is ``jax.distributed`` (see ``collectives.init``); this
+service exists for legacy/external workers and for launch-time rank
+bookkeeping on ssh clusters.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
+from dmlc_core_tpu.parallel.collectives import get_link_map
+
+__all__ = ["RabitTracker", "PSTracker", "submit"]
+
+
+class RabitTracker:
+    """Rank-assignment + topology service over TCP/JSON lines."""
+
+    def __init__(self, host_ip: str = "127.0.0.1", nworker: int = 1, port: int = 0):
+        self.nworker = nworker
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host_ip, port))
+        self._sock.listen(max(16, nworker))
+        self.host_ip = host_ip
+        self.port = self._sock.getsockname()[1]
+        self._links = get_link_map(nworker)
+        self._next_rank = 0
+        self._host_rank: Dict[str, int] = {}  # host-aware rank reuse
+        self._shutdown_count = 0
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- env ABI ---------------------------------------------------------
+    def slave_envs(self) -> Dict[str, str]:
+        """Env vars every worker must see.  Reference: ``slave_envs()``."""
+        return {
+            "DMLC_TRACKER_URI": self.host_ip,
+            "DMLC_TRACKER_PORT": str(self.port),
+            "DMLC_NUM_WORKER": str(self.nworker),
+        }
+
+    # -- service loop ----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._done.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                buf = b""
+                while b"\n" not in buf:
+                    data = conn.recv(4096)
+                    if not data:
+                        return
+                    buf += data
+                msg = json.loads(buf.split(b"\n", 1)[0])
+                reply = self._handle(msg)
+                if reply is not None:
+                    conn.sendall(json.dumps(reply).encode() + b"\n")
+        except (json.JSONDecodeError, OSError) as e:
+            LOG("WARNING", "tracker: bad worker message: %s", e)
+
+    def _handle(self, msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        cmd = msg.get("cmd")
+        if cmd == "print":
+            LOG("INFO", "worker: %s", msg.get("msg", ""))
+            return None
+        if cmd == "shutdown":
+            with self._lock:
+                self._shutdown_count += 1
+                if self._shutdown_count >= self.nworker:
+                    self._done.set()
+            return {"ok": True}
+        if cmd in ("start", "recover"):
+            with self._lock:
+                if cmd == "recover" and "rank" in msg and msg["rank"] >= 0:
+                    rank = int(msg["rank"])  # rejoining worker keeps its rank
+                elif msg.get("host") and msg["host"] in self._host_rank and cmd == "recover":
+                    rank = self._host_rank[msg["host"]]
+                else:
+                    rank = self._next_rank
+                    self._next_rank += 1
+                    if msg.get("host"):
+                        self._host_rank[msg["host"]] = rank
+            if rank >= self.nworker:
+                return {"error": f"too many workers (nworker={self.nworker})"}
+            link = self._links[rank]
+            return {
+                "rank": rank,
+                "num_worker": self.nworker,
+                "parent": link["parent"],
+                "children": link["children"],
+                "ring_prev": link["ring_prev"],
+                "ring_next": link["ring_next"],
+            }
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block until all workers sent 'shutdown'."""
+        self._done.wait(timeout)
+
+    def stop(self) -> None:
+        self._done.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- client side (worker) -------------------------------------------
+    @staticmethod
+    def worker_connect(uri: str, port: int, cmd: str = "start",
+                       host: str = "", rank: int = -1) -> Dict[str, Any]:
+        """Worker-side handshake (what rabit's C++ client did at Init)."""
+        with socket.create_connection((uri, port), timeout=10) as s:
+            s.sendall(json.dumps({"cmd": cmd, "host": host, "rank": rank}).encode() + b"\n")
+            buf = b""
+            while b"\n" not in buf:
+                data = s.recv(4096)
+                if not data:
+                    log_fatal("tracker connection closed mid-handshake")
+                buf += data
+        return json.loads(buf.split(b"\n", 1)[0])
+
+
+class PSTracker:
+    """Parameter-server role bootstrap.
+
+    Reference parity: ``tracker.py :: PSTracker`` — exports
+    ``DMLC_PS_ROOT_URI/PORT`` and role env vars.  The actual PS engine is
+    replaced by the KVStore shim over XLA collectives
+    (``dmlc_core_tpu.parallel.kvstore``), so this only serves the ABI.
+    """
+
+    def __init__(self, host_ip: str = "127.0.0.1", port: int = 9092,
+                 nworker: int = 1, nserver: int = 0):
+        self.host_ip, self.port = host_ip, port
+        self.nworker, self.nserver = nworker, nserver
+
+    def slave_envs(self) -> Dict[str, str]:
+        return {
+            "DMLC_PS_ROOT_URI": self.host_ip,
+            "DMLC_PS_ROOT_PORT": str(self.port),
+            "DMLC_NUM_WORKER": str(self.nworker),
+            "DMLC_NUM_SERVER": str(self.nserver),
+        }
+
+    def worker_envs(self) -> Dict[str, str]:
+        return {**self.slave_envs(), "DMLC_ROLE": "worker"}
+
+    def server_envs(self) -> Dict[str, str]:
+        return {**self.slave_envs(), "DMLC_ROLE": "server"}
+
+    def scheduler_envs(self) -> Dict[str, str]:
+        return {**self.slave_envs(), "DMLC_ROLE": "scheduler"}
+
+
+def submit(
+    nworker: int,
+    nserver: int,
+    fun_submit: Callable[[int, Dict[str, str]], Any],
+    host_ip: str = "127.0.0.1",
+    start_tracker: bool = False,
+) -> Optional[RabitTracker]:
+    """Launch-glue.  Reference parity: ``tracker.py :: submit``.
+
+    Picks rabit vs PS mode (``nserver == 0`` → rabit, like the reference),
+    builds the env ABI, and calls ``fun_submit(nworker_total, envs)`` which
+    performs the actual process launch (local/ssh backend).
+
+    JAX workers coordinate via ``jax.distributed`` on
+    ``DMLC_TRACKER_URI:PORT`` (process 0 hosts the service), so the
+    RabitTracker TCP service is only started when ``start_tracker=True``
+    (legacy workers); it then runs on its *own* port, exported as
+    ``DMLC_LEGACY_TRACKER_PORT``.
+    """
+    CHECK(nworker >= 1, "need at least one worker")
+    envs: Dict[str, str] = {
+        "DMLC_NUM_WORKER": str(nworker),
+        "DMLC_NUM_SERVER": str(nserver),
+    }
+    tracker: Optional[RabitTracker] = None
+    if nserver == 0:
+        envs["DMLC_TRACKER_URI"] = host_ip
+        envs["DMLC_TRACKER_PORT"] = str(_free_port(host_ip))
+        if start_tracker:
+            tracker = RabitTracker(host_ip=host_ip, nworker=nworker)
+            tracker.start()
+            envs["DMLC_LEGACY_TRACKER_PORT"] = str(tracker.port)
+    else:
+        ps = PSTracker(host_ip=host_ip, nworker=nworker, nserver=nserver)
+        envs.update(ps.slave_envs())
+        envs["DMLC_TRACKER_URI"] = host_ip
+        envs["DMLC_TRACKER_PORT"] = str(_free_port(host_ip))
+    fun_submit(nworker + nserver, envs)
+    return tracker
+
+
+def _free_port(host_ip: str) -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host_ip, 0))
+        return s.getsockname()[1]

@@ -217,6 +217,28 @@ class TestParsers:
                     labels.extend(block.label.tolist())
             assert len(labels) == 500
 
+    def test_plus_signed_labels_and_empty_value(self, parse_mode):
+        # canonical LibSVM '+1' labels and 'idx:' empty values
+        with TemporaryDirectory() as tmp:
+            path = os.path.join(tmp.path, "p.libsvm")
+            with open(path, "w") as f:
+                f.write("+1 3:+2.5 7:\n-1 2:1\n")
+            b = next(iter(Parser.create(path, format="libsvm")))
+            np.testing.assert_allclose(b.label, [1, -1])
+            np.testing.assert_allclose(b.value, [2.5, 1.0, 1.0])
+
+    def test_weight_column_presence_survives_cache(self):
+        # schema presence (all-1.0 weights) must survive container round trip
+        c = RowBlockContainer()
+        c.push(1.0, [0], [1.0], weight=1.0)
+        c.push(0.0, [1], [2.0], weight=1.0)
+        s = MemoryStringStream()
+        c.save(s)
+        s.seek(0)
+        c2 = RowBlockContainer()
+        assert c2.load(s)
+        assert c2.to_block().weight is not None
+
     def test_native_matches_python(self):
         if not _native.native_available():
             pytest.skip("native library not built")
