@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Benchmark: hist-GBT boosting rounds/sec/chip (BASELINE config 1 proxy).
+
+Runs on whatever jax.devices() provides (the real TPU chip under axon; CPU
+elsewhere).  HIGGS-scale synthetic data — BENCH_ROWS×28 dense features,
+binary labels — quantile-binned once, then ``BENCH_ROUNDS`` boosting rounds
+of depth ``BENCH_DEPTH`` after ``BENCH_WARMUP`` discarded warmup rounds
+(compile + cache), per BASELINE.md's measurement plan.
+
+Prints ONE JSON line:
+  {"metric": "histgbt_rounds_per_sec_per_chip", "value": N,
+   "unit": "rounds/s/chip", "vs_baseline": N, ...}
+
+vs_baseline: the reference publishes no numbers (SURVEY.md §6); the target
+is the BASELINE.json north star — XGBoost 2.x hist on one 8×A100 NCCL node
+trains HIGGS-10M at roughly 8 rounds/s aggregate (~1 round/s/GPU at depth
+6, 256 bins; public xgboost-bench figures), so parity per chip ≈ 1.0
+round/s/chip.  vs_baseline = value / 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 100))
+    warmup = int(os.environ.get("BENCH_WARMUP", 10))
+    depth = int(os.environ.get("BENCH_DEPTH", 6))
+    n_bins = int(os.environ.get("BENCH_BINS", 256))
+
+    import threading
+
+    import jax
+
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.parallel.mesh import local_mesh
+
+    # Backend-init watchdog: if the TPU tunnel is wedged, device discovery
+    # hangs in C land; fall back to CPU so the bench always emits its JSON
+    # line (platform is recorded so a fallback run is visible).
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
+    probe: dict = {}
+
+    def _probe():
+        try:
+            probe["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001
+            probe["error"] = str(e)
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(init_timeout)
+    if "devices" not in probe:
+        print(json.dumps({
+            "metric": "histgbt_rounds_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "rounds/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"device init did not complete in {init_timeout}s "
+                     f"(TPU tunnel wedged?): {probe.get('error', 'timeout')}",
+        }))
+        os._exit(2)
+
+    devices = probe["devices"]
+    platform = devices[0].platform
+
+    # HIGGS-like synthetic: dense gaussians + a nonlinear decision rule
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(rows, feats)).astype(np.float32)
+    margin = X[:, 0] * X[:, 1] + 0.5 * X[:, 2] - 0.8 * X[:, 3] * (X[:, 4] > 0)
+    y = (margin > 0).astype(np.float32)
+
+    mesh = local_mesh()  # all local devices on the data axis (1 chip → 1)
+    n_chips = mesh.devices.size
+    model = HistGBT(
+        n_trees=rounds,
+        max_depth=depth,
+        n_bins=n_bins,
+        learning_rate=0.1,
+        mesh=mesh,
+    )
+    model.fit(X, y, warmup_rounds=warmup)
+    seconds = model.last_fit_seconds
+    rounds_per_sec_per_chip = rounds / seconds / n_chips
+
+    target = 1.0  # rounds/s/chip ≈ per-GPU rate of the 8×A100 NCCL baseline
+    print(json.dumps({
+        "metric": "histgbt_rounds_per_sec_per_chip",
+        "value": round(rounds_per_sec_per_chip, 4),
+        "unit": "rounds/s/chip",
+        "vs_baseline": round(rounds_per_sec_per_chip / target, 4),
+        "rows": rows,
+        "features": feats,
+        "rounds": rounds,
+        "max_depth": depth,
+        "n_bins": n_bins,
+        "chips": n_chips,
+        "platform": platform,
+        "seconds": round(seconds, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

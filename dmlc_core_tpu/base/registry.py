@@ -98,16 +98,19 @@ class Registry(Generic[E]):
     def register(self, name: str, entry: Optional[E] = None, override: bool = False):
         """Register ``entry`` under ``name``.
 
-        With no ``entry``, returns a decorator that wraps the decorated
-        callable in a :class:`FunctionRegEntry` (or registers it directly if
-        it already is one).
+        With no ``entry``, returns a decorator: plain functions are wrapped
+        in a :class:`FunctionRegEntry` (carrying ``__doc__`` as the
+        description); classes and existing FunctionRegEntry objects are
+        registered as themselves (a class's docs live on the class).
         """
         if entry is not None:
             self._register(name, entry, override)
             return entry
 
         def deco(obj: Any) -> Any:
-            if isinstance(obj, FunctionRegEntry):
+            if isinstance(obj, FunctionRegEntry) or isinstance(obj, type):
+                # entries and classes register as themselves; plain
+                # functions get wrapped so they carry docs/arguments
                 self._register(name, obj, override)
             else:
                 e = FunctionRegEntry(name).set_body(obj)

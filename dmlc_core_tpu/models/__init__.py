@@ -1,0 +1,15 @@
+"""Model families built on the substrate (TPU-first consumers).
+
+The reference is infrastructure under XGBoost/MXNet; these modules are the
+TPU-native stand-ins for those consumers, proving the substrate end-to-end
+and carrying the benchmarks:
+
+* :mod:`histgbt` — XGBoost-style hist gradient-boosted trees, data-parallel
+  over the mesh with psum histogram sync (BASELINE configs 1/3 flagship).
+* :mod:`resnet` — image trainer fed by the RecordIO infeed pipeline
+  (BASELINE config 2).
+* :mod:`bert` — transformer encoder trained with KVStore-shaped gradient
+  sync (BASELINE config 4).
+"""
+
+from dmlc_core_tpu.models.histgbt import HistGBT, HistGBTParam  # noqa: F401

@@ -1,0 +1,15 @@
+"""Device ops (TPU-first additions; no reference counterpart).
+
+The reference has no compute kernels — its consumers (XGBoost) brought
+their own.  A TPU-native substrate must supply the device-side primitives
+those consumers need, designed for XLA/MXU rather than translated:
+
+* :mod:`histogram` — gradient histograms for hist-method tree growth
+  (the FLOP core of BASELINE configs 1/3).
+* :mod:`quantile` — distributed weighted quantile sketch for feature
+  binning (config 3's variable-size sketch allreduce, done the TPU way:
+  fixed-size summaries + allgather-merge).
+"""
+
+from dmlc_core_tpu.ops.histogram import build_histogram  # noqa: F401
+from dmlc_core_tpu.ops.quantile import compute_cuts, apply_bins  # noqa: F401

@@ -1,0 +1,109 @@
+"""Distributed weighted quantile binning (the sketch layer).
+
+XGBoost's hist method bins features at per-feature (weighted) quantile cut
+points, merged across workers.  The reference world does this with
+variable-size quantile sketches allreduced over rabit (BASELINE config 3's
+hard part).  The TPU-native design replaces the variable-size merge with a
+**fixed-size summary + allgather-merge** (SURVEY.md §7 hard part (c)):
+
+1. each worker summarizes every feature with a fixed grid of
+   ``n_summary`` weighted quantiles of its local rows — fixed shape
+   ``[F, n_summary]``, psum/allgather-friendly;
+2. summaries are allgathered (one XLA AllGather over ICI instead of a
+   variable-size sketch protocol);
+3. the merged multiset of summary points is re-quantiled into ``n_bins-1``
+   cut points, identically on every worker (deterministic, no broadcast
+   needed).
+
+Exactness matches sketch-based binning in spirit: with ``n_summary ≥
+8·n_bins`` the cut error is far below a bin width in practice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlc_core_tpu.base.logging import CHECK
+
+__all__ = ["local_summary", "merge_summaries", "compute_cuts", "apply_bins"]
+
+
+@partial(jax.jit, static_argnums=(2,))
+def local_summary(x: jax.Array, weight: Optional[jax.Array], n_summary: int) -> jax.Array:
+    """Fixed-size weighted quantile summary of local rows.
+
+    ``x``: [n, F] f32; ``weight``: [n] or None.  Returns [F, n_summary]
+    (per-feature weighted quantiles on an even probability grid).
+    """
+    n, F = x.shape
+    qs = jnp.linspace(0.0, 1.0, n_summary)
+    if weight is None:
+        return jnp.quantile(x, qs, axis=0).T  # [F, n_summary]
+    order = jnp.argsort(x, axis=0)                                    # [n, F]
+    xs = jnp.take_along_axis(x, order, axis=0)
+    ws = weight[order]                                                # [n, F]
+    cw = jnp.cumsum(ws, axis=0)
+    total = cw[-1:, :]
+    probs = (cw - 0.5 * ws) / total                                   # midpoint rule
+    def per_f(xf, pf):
+        return jnp.interp(qs, pf, xf)
+    return jax.vmap(per_f, in_axes=(1, 1))(xs, probs)                 # [F, n_summary]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def merge_summaries(gathered: jax.Array, n_bins: int) -> jax.Array:
+    """Merge ``[W, F, n_summary]`` worker summaries into ``[F, n_bins-1]``
+    cut points (interior boundaries; bin b = count of cuts ≤ x)."""
+    W, F, S = gathered.shape
+    merged = jnp.transpose(gathered, (1, 0, 2)).reshape(F, W * S)
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    cuts = jnp.quantile(merged, qs, axis=1).T                         # [F, n_bins-1]
+    # strictly increasing guard: collapse duplicate cuts upward by epsilon
+    eps = jnp.maximum(jnp.abs(cuts) * 1e-6, 1e-6)
+    cuts = jnp.maximum(cuts, jnp.concatenate(
+        [cuts[:, :1] - 1.0, cuts[:, :-1] + eps[:, :-1]], axis=1))
+    return cuts
+
+
+def compute_cuts(
+    x: np.ndarray,
+    n_bins: int = 256,
+    weight: Optional[np.ndarray] = None,
+    n_summary: Optional[int] = None,
+    allgather_fn=None,
+) -> jax.Array:
+    """End-to-end cut computation.
+
+    ``allgather_fn(summary) -> [W, F, S]`` injects the distributed gather
+    (e.g. ``collectives.allgather`` across processes, or an in-mesh
+    all_gather); None means single worker.
+    """
+    CHECK(n_bins >= 2, "need at least 2 bins")
+    n_summary = n_summary or max(8 * n_bins, 64)
+    summary = local_summary(jnp.asarray(x), None if weight is None else jnp.asarray(weight),
+                            n_summary)
+    if allgather_fn is not None:
+        gathered = jnp.asarray(allgather_fn(np.asarray(summary)))
+    else:
+        gathered = summary[None]
+    return merge_summaries(gathered, n_bins)
+
+
+@jax.jit
+def apply_bins(x: jax.Array, cuts: jax.Array) -> jax.Array:
+    """Digitize ``x`` [n, F] by per-feature ``cuts`` [F, n_bins-1] →
+    int32 bins [n, F] (bin = #cuts ≤ value, so bins ∈ [0, n_bins-1]).
+
+    Per-feature ``searchsorted`` (binary search, O(n·log C)) rather than a
+    broadcast-compare, which would materialize an [n, F, C] intermediate —
+    prohibitive at HIGGS scale (10M × 28 × 255).
+    """
+    return jax.vmap(
+        lambda col, c: jnp.searchsorted(c, col, side="right"),
+        in_axes=(1, 0), out_axes=1,
+    )(x, cuts).astype(jnp.int32)

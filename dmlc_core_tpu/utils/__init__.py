@@ -1,0 +1,3 @@
+"""Shared runtime utilities (platform control, profiling)."""
+
+from dmlc_core_tpu.utils.platform import force_cpu_devices  # noqa: F401

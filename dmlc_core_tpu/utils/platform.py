@@ -1,0 +1,47 @@
+"""Platform selection helpers.
+
+On TPU terminals the platform plugin may force the platform list through
+``jax.config`` at interpreter startup (e.g. the axon tunnel's site hook
+sets ``jax_platforms="axon,cpu"``), which silently overrides the
+``JAX_PLATFORMS`` env var.  Tests and CPU-only tools must therefore force
+the platform through ``jax.config`` as well — env vars alone are not
+enough — and must do it before the first backend initialization.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = ["force_cpu_devices"]
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Force jax onto ``n`` virtual CPU devices (never the real TPU).
+
+    Call before any jax operation.  Replaces any existing device-count in
+    XLA_FLAGS (e.g. one inherited from a parent process) rather than
+    keeping it.  Raises RuntimeError if jax backends were already
+    initialized — at that point the platform can no longer be changed and
+    silently continuing could mean running on the real chip.
+    """
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        devs = jax.devices()
+        if devs and (devs[0].platform != "cpu" or len(devs) != n):
+            raise RuntimeError(
+                f"force_cpu_devices({n}): jax backends already initialized "
+                f"({len(devs)} {devs[0].platform} devices) — call before any "
+                f"jax operation"
+            )
+        return
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(rf"{_COUNT_FLAG}=\d+", "", flags).strip()
+    os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n}").strip()
+    jax.config.update("jax_platforms", "cpu")

@@ -1,17 +1,25 @@
 """Test harness config.
 
-Tests run on CPU with a virtual 8-device mesh so the multi-chip sharding path
-(shard_map / psum over a named Mesh) is exercised without TPU hardware — the
-TPU-world analogue of the reference's ``dmlc_tracker/local.py`` multi-process
-testing pattern (SURVEY.md §4).  Env vars must be set before jax imports.
+Tests run on CPU with a virtual 8-device mesh so the multi-chip sharding
+path (shard_map / psum over a named Mesh) is exercised without TPU hardware
+— the TPU-world analogue of the reference's ``dmlc_tracker/local.py``
+multi-process testing pattern (SURVEY.md §4).
+
+Platform forcing must happen BEFORE any jax backend init, and must go
+through jax.config as well as env vars: the axon TPU tunnel's site hook
+overrides JAX_PLATFORMS, and touching the real chip from tests both skews
+results and (when the tunnel is busy) hangs.  See
+dmlc_core_tpu.utils.platform.
 """
 
+import sys
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-prev = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_core_tpu.utils import force_cpu_devices
+
+force_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

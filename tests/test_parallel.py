@@ -269,8 +269,9 @@ class TestRabitTracker:
 
 WORKER_SCRIPT = textwrap.dedent(
     """
+    from dmlc_core_tpu.utils import force_cpu_devices
+    force_cpu_devices(1)
     import os
-    os.environ["JAX_PLATFORMS"] = "cpu"
     import numpy as np
     from dmlc_core_tpu.parallel import collectives as coll
 
