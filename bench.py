@@ -66,7 +66,7 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": f"device init did not complete in {init_timeout}s "
                      f"(TPU tunnel wedged?): {probe.get('error', 'timeout')}",
-        }))
+        }), flush=True)
         os._exit(2)
 
     devices = probe["devices"]
@@ -87,7 +87,18 @@ def main() -> None:
         learning_rate=0.1,
         mesh=mesh,
     )
-    model.fit(X, y, warmup_rounds=warmup)
+    try:
+        model.fit(X, y, warmup_rounds=warmup)
+    except Exception as e:  # noqa: BLE001 — bench must always emit its JSON line
+        print(json.dumps({
+            "metric": "histgbt_rounds_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "rounds/s/chip",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }), flush=True)
+        os._exit(3)
     seconds = model.last_fit_seconds
     rounds_per_sec_per_chip = rounds / seconds / n_chips
 

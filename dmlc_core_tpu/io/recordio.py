@@ -65,6 +65,15 @@ class RecordIOWriter:
         self._stream = stream
         self.except_counter = 0  # number of embedded magics escaped
 
+    def close(self) -> None:
+        self._stream.close()
+
+    def __enter__(self) -> "RecordIOWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def write_record(self, data: bytes) -> None:
         CHECK_LT(len(data), 1 << 29, "RecordIO: record too large")
         size = len(data)
@@ -103,6 +112,15 @@ class RecordIOReader:
 
     def __init__(self, stream: Stream):
         self._stream = stream
+
+    def close(self) -> None:
+        self._stream.close()
+
+    def __enter__(self) -> "RecordIOReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def next_record(self) -> Optional[bytes]:
         """Return the next record, or None at EOF."""

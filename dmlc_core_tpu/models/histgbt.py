@@ -100,7 +100,7 @@ class HistGBTParam(Parameter):
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror"])
     base_score = field(float, default=0.0, description="initial raw margin")
-    hist_method = field(str, default="segment", enum=["segment", "onehot"],
+    hist_method = field(str, default="auto", enum=["auto", "segment", "matmul"],
                         description="histogram engine (ops.histogram)")
 
 
@@ -215,10 +215,10 @@ class HistGBT:
         half = max(n_leaf >> 1, 1)
 
         def best_split(hist):
-            """hist [N,F,B,2] → (feat [N], thr [N]); degenerate split
+            """hist [2,N,F,B] → (feat [N], thr [N]); degenerate split
             (feat 0, thr B-1 → everyone left) when gain ≤ gamma."""
-            g = hist[..., 0]
-            h = hist[..., 1]
+            g = hist[0]
+            h = hist[1]
             gl = jnp.cumsum(g, axis=-1)[..., :-1]        # [N,F,B-1] left: bin ≤ b
             hl = jnp.cumsum(h, axis=-1)[..., :-1]
             gt = jnp.sum(g, axis=-1, keepdims=True)      # [N,F,1]

@@ -2,22 +2,31 @@
 
 The hot op of XGBoost-style training (BASELINE config 1): for every tree
 node, feature and bin, accumulate Σgrad and Σhess of the rows that land
-there.  Two XLA formulations, selected by ``method``:
+there.  XLA formulations, selected by ``method``:
 
 * ``"segment"`` — one flat ``segment_sum`` over the combined
-  ``(node, feature, bin)`` index.  O(n·F) memory traffic; lowers to XLA
-  scatter-add.  Best on CPU and the general-purpose default.
-* ``"onehot"`` — MXU formulation: per feature, a ``[2·nodes, n] @ [n, B]``
-  bf16 matmul where the LHS rows are the node one-hot scaled by g (then h)
-  and the RHS is the bin one-hot.  Turns the scatter into dense matmuls the
-  systolic array eats; preferable on TPU when ``nodes`` is small (early
-  levels) and B is moderate.  fp32 accumulation via
-  ``preferred_element_type``.
+  ``(node, feature, bin)`` index, run separately for grad and hess.
+  Lowers to XLA scatter-add: fast on CPU, slow on TPU (scatter
+  serializes); the CPU default.
+* ``"matmul"`` — MXU formulation, the TPU default: scan over row blocks;
+  per block the LHS ``[R, 2N]`` holds the node one-hot scaled by g (then
+  h) and the RHS ``[R, F·B]`` is the bin one-hot, so ONE bf16 matmul
+  with f32 accumulation (``preferred_element_type``) yields the whole
+  block's contribution.  Blocking bounds the one-hot materialization to
+  ~100MB regardless of n.
+* ``"auto"`` — picks by backend platform (tpu → matmul, else segment).
 
-Both are pure functions of arrays — safe inside jit/shard_map; the
-data-parallel trainer psums the result over the mesh's ``data`` axis
-(the histogram-sync allreduce that replaces rabit's socket tree,
-SURVEY.md §5).
+TPU layout note: the result is ``[2, n_nodes, F, n_bins]`` with the
+grad/hess plane LEADING.  A trailing axis of size 2 is catastrophic under
+the TPU ``T(8,128)`` tiled layout — the minor dimension pads 2 → 128, a
+64× memory blowup (observed as a 57GB alloc for a ``f32[112e6, 2]`` on a
+16GB chip).  Never stack grad/hess on the minor axis of a large array.
+
+All formulations are pure functions of arrays — safe inside
+jit/shard_map; the data-parallel trainer psums the result over the mesh's
+``data`` axis (the histogram-sync allreduce that replaces rabit's socket
+tree, SURVEY.md §5; reference: rabit's Allreduce over
+``tracker/dmlc_tracker/tracker.py :: get_tree`` topology).
 """
 
 from __future__ import annotations
@@ -30,11 +39,16 @@ import numpy as np
 
 from dmlc_core_tpu.base.logging import log_fatal
 
-__all__ = ["build_histogram", "histogram_methods"]
+__all__ = ["build_histogram", "histogram_methods", "reference_histogram"]
+
+# rows per MXU block: one-hot RHS is [R, F·B] bf16 — at F=28, B=256 and
+# R=8192 that is ~117MB, safely inside HBM working set while keeping the
+# matmul [2N, R]·[R, F·B] large enough to saturate the systolic array.
+_BLOCK_ROWS = 8192
 
 
 def histogram_methods() -> list[str]:
-    return ["segment", "onehot"]
+    return ["auto", "segment", "matmul"]
 
 
 def build_histogram(
@@ -44,17 +58,19 @@ def build_histogram(
     hess: jax.Array,        # [n] f32
     n_nodes: int,
     n_bins: int,
-    method: str = "segment",
+    method: str = "auto",
 ) -> jax.Array:
-    """Return ``hist[n_nodes, F, n_bins, 2]`` with (Σgrad, Σhess).
+    """Return ``hist[2, n_nodes, F, n_bins]`` — plane 0 Σgrad, plane 1 Σhess.
 
     Static ``n_nodes``/``n_bins`` keep shapes XLA-compilable; rows with
     ``node_id < 0`` (e.g. padding) contribute nothing.
     """
+    if method == "auto":
+        method = "matmul" if jax.default_backend() == "tpu" else "segment"
     if method == "segment":
         return _hist_segment(bins, node_id, grad, hess, n_nodes, n_bins)
-    if method == "onehot":
-        return _hist_onehot(bins, node_id, grad, hess, n_nodes, n_bins)
+    if method == "matmul":
+        return _hist_matmul(bins, node_id, grad, hess, n_nodes, n_bins)
     log_fatal(f"build_histogram: unknown method {method!r}")
 
 
@@ -68,53 +84,73 @@ def _hist_segment(bins, node_id, grad, hess, n_nodes, n_bins):
     seg = (safe_node[:, None] * (F * n_bins)
            + feat_ids * n_bins
            + bins.astype(jnp.int32))                                      # [n, F]
-    gmask = jnp.where(valid, grad, 0.0)
-    hmask = jnp.where(valid, hess, 0.0)
-    data = jnp.stack(
-        [jnp.broadcast_to(gmask[:, None], (n, F)),
-         jnp.broadcast_to(hmask[:, None], (n, F))], axis=-1)              # [n, F, 2]
-    flat = jax.ops.segment_sum(
-        data.reshape(n * F, 2),
-        seg.reshape(n * F),
-        num_segments=n_nodes * F * n_bins,
-    )
-    return flat.reshape(n_nodes, F, n_bins, 2)
+    num = n_nodes * F * n_bins
+    seg_flat = seg.reshape(n * F)
+
+    def one(v):
+        data = jnp.broadcast_to(jnp.where(valid, v, 0.0)[:, None], (n, F))
+        return jax.ops.segment_sum(data.reshape(n * F), seg_flat, num_segments=num)
+
+    return jnp.stack([one(grad), one(hess)]).reshape(2, n_nodes, F, n_bins)
 
 
-@partial(jax.jit, static_argnums=(4, 5))
-def _hist_onehot(bins, node_id, grad, hess, n_nodes, n_bins):
+@partial(jax.jit, static_argnums=(4, 5, 6))
+def _hist_matmul(bins, node_id, grad, hess, n_nodes, n_bins,
+                 block_rows: int = _BLOCK_ROWS):
     n, F = bins.shape
-    valid = node_id >= 0
-    safe_node = jnp.where(valid, node_id, 0)
-    node_oh = jax.nn.one_hot(safe_node, n_nodes, dtype=jnp.bfloat16)      # [n, N]
-    gmask = jnp.where(valid, grad, 0.0).astype(jnp.bfloat16)
-    hmask = jnp.where(valid, hess, 0.0).astype(jnp.bfloat16)
-    # LHS [n, 2N]: node one-hot scaled by g | by h → one matmul per feature
-    lhs = jnp.concatenate([node_oh * gmask[:, None], node_oh * hmask[:, None]], axis=1)
+    # even out block sizes (rounded to sublane multiples) so padding is at
+    # most nblk·8 rows — a fixed R would pad up to R-1 rows (≈2× work for
+    # n just above a block multiple)
+    nblk = -(-n // block_rows)
+    per_blk = -(-n // nblk)
+    R = -(-per_blk // 8) * 8
+    pad = nblk * R - n
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        node_id = jnp.pad(node_id, (0, pad), constant_values=-1)
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+    nblk = (n + pad) // R
+    blocks = (
+        bins.reshape(nblk, R, F),
+        node_id.reshape(nblk, R),
+        grad.reshape(nblk, R),
+        hess.reshape(nblk, R),
+    )
 
-    def per_feature(bins_f):
-        bin_oh = jax.nn.one_hot(bins_f, n_bins, dtype=jnp.bfloat16)       # [n, B]
+    def body(acc, blk):
+        b_bins, b_node, b_g, b_h = blk
+        valid = b_node >= 0
+        safe = jnp.where(valid, b_node, 0)
+        node_oh = jax.nn.one_hot(safe, n_nodes, dtype=jnp.bfloat16)       # [R, N]
+        g = jnp.where(valid, b_g, 0.0).astype(jnp.bfloat16)
+        h = jnp.where(valid, b_h, 0.0).astype(jnp.bfloat16)
+        lhs = jnp.concatenate(
+            [node_oh * g[:, None], node_oh * h[:, None]], axis=1)         # [R, 2N]
+        bin_oh = jax.nn.one_hot(
+            b_bins.astype(jnp.int32), n_bins, dtype=jnp.bfloat16
+        ).reshape(R, F * n_bins)                                          # [R, F·B]
         m = jax.lax.dot_general(
             lhs, bin_oh,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                                                  # [2N, B]
-        return m
+        )                                                                  # [2N, F·B]
+        return acc + m, None
 
-    ms = jax.lax.map(per_feature, bins.T.astype(jnp.int32))               # [F, 2N, B]
-    ms = ms.reshape(F, 2, n_nodes, n_bins)
-    return jnp.transpose(ms, (2, 0, 3, 1))                                # [N, F, B, 2]
+    acc0 = jnp.zeros((2 * n_nodes, F * n_bins), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, blocks)
+    return acc.reshape(2, n_nodes, F, n_bins)
 
 
 def reference_histogram(bins, node_id, grad, hess, n_nodes, n_bins):
-    """Numpy oracle for tests."""
+    """Numpy oracle for tests — same [2, N, F, B] shape as build_histogram."""
     bins = np.asarray(bins)
     node_id = np.asarray(node_id)
-    out = np.zeros((n_nodes, bins.shape[1], n_bins, 2), np.float64)
+    out = np.zeros((2, n_nodes, bins.shape[1], n_bins), np.float64)
     for i in range(bins.shape[0]):
         if node_id[i] < 0:
             continue
         for f in range(bins.shape[1]):
-            out[node_id[i], f, bins[i, f], 0] += grad[i]
-            out[node_id[i], f, bins[i, f], 1] += hess[i]
+            out[0, node_id[i], f, bins[i, f]] += grad[i]
+            out[1, node_id[i], f, bins[i, f]] += hess[i]
     return out.astype(np.float32)
