@@ -2,11 +2,11 @@
 
 Reference parity: ``tracker/dmlc_tracker/opts.py :: get_opts`` — cluster
 selection, worker counts, resources, env passthrough (SURVEY.md §2c).
-Cluster backends kept: ``local`` (single machine, the test path) and
-``ssh`` (ad-hoc clusters).  YARN/SGE/Slurm/Mesos/K8s launchers from the
-reference are cluster-manager integrations orthogonal to the TPU redesign;
-on TPU pods the platform launcher (GKE/queued resources) replaces them —
-the env ABI below is what carries over.
+All reference clusters are supported: ``local`` (single machine, the test
+path), ``ssh``, ``mpi``, ``sge``, ``slurm``, ``yarn``, ``mesos``,
+``kubernetes``.  On TPU pods, ``kubernetes`` (GKE) is the idiomatic
+launcher; either way the ``DMLC_*`` env ABI is what workers consume
+(``collectives.init()`` → jax.distributed).
 """
 
 from __future__ import annotations
@@ -14,7 +14,9 @@ from __future__ import annotations
 import argparse
 from typing import List, Optional, Tuple
 
-__all__ = ["get_opts"]
+__all__ = ["CLUSTERS", "get_opts"]
+
+CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "yarn", "mesos", "kubernetes"]
 
 
 def get_opts(args: Optional[List[str]] = None) -> Tuple[argparse.Namespace, List[str]]:
@@ -22,17 +24,29 @@ def get_opts(args: Optional[List[str]] = None) -> Tuple[argparse.Namespace, List
         prog="dmlc-submit",
         description="Submit a distributed dmlc_core_tpu job",
     )
-    parser.add_argument("--cluster", choices=["local", "ssh"], default="local",
+    parser.add_argument("--cluster", choices=CLUSTERS, default="local",
                         help="launch backend")
     parser.add_argument("-n", "--num-workers", type=int, required=True,
                         help="number of worker processes")
     parser.add_argument("-s", "--num-servers", type=int, default=0,
                         help="number of server processes (PS mode)")
     parser.add_argument("-H", "--host-file", type=str, default=None,
-                        help="file listing one host per line (ssh cluster)")
+                        help="file listing one host per line (ssh/mpi clusters)")
     parser.add_argument("--host-ip", type=str, default="127.0.0.1",
                         help="tracker/coordinator bind address")
     parser.add_argument("--jobname", type=str, default="dmlc-job")
+    parser.add_argument("--queue", type=str, default=None,
+                        help="scheduler queue/partition (sge/slurm/yarn)")
+    parser.add_argument("--worker-cores", type=int, default=None,
+                        help="cores per worker (resource-managed clusters)")
+    parser.add_argument("--worker-memory", type=int, default=None,
+                        help="MB of memory per worker (resource-managed clusters)")
+    parser.add_argument("--image", type=str, default=None,
+                        help="container image (kubernetes cluster)")
+    parser.add_argument("--mesos-master", type=str, default=None,
+                        help="mesos master host:port")
+    parser.add_argument("--max-attempts", type=int, default=3,
+                        help="max restart attempts per worker (kubernetes)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VALUE env for workers (repeatable)")
     parser.add_argument("--log-level", choices=["DEBUG", "INFO", "WARNING", "ERROR"],

@@ -16,8 +16,6 @@ import sys
 from typing import List, Optional
 
 from dmlc_core_tpu.base.logging import CHECK, set_log_level
-from dmlc_core_tpu.tracker import local as local_backend
-from dmlc_core_tpu.tracker import ssh as ssh_backend
 from dmlc_core_tpu.tracker.opts import get_opts
 from dmlc_core_tpu.tracker.tracker import submit as tracker_submit
 
@@ -33,16 +31,47 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     def fun_submit(n_total: int, envs) -> None:
         envs = {**envs, **extra_env}
+        nw = opts.num_workers
         if opts.cluster == "local":
-            exit_codes.extend(
-                local_backend.launch(opts.num_workers, command, envs)
-            )
+            from dmlc_core_tpu.tracker import local as be
+            exit_codes.extend(be.launch(nw, command, envs))
         elif opts.cluster == "ssh":
+            from dmlc_core_tpu.tracker import ssh as be
             CHECK(opts.host_file is not None, "--cluster ssh needs --host-file")
-            hosts = ssh_backend.read_host_file(opts.host_file)
-            exit_codes.extend(
-                ssh_backend.launch(opts.num_workers, command, envs, hosts)
-            )
+            hosts = be.read_host_file(opts.host_file)
+            exit_codes.extend(be.launch(nw, command, envs, hosts))
+        elif opts.cluster == "mpi":
+            from dmlc_core_tpu.tracker import mpi as be
+            exit_codes.extend(be.launch(nw, command, envs, host_file=opts.host_file))
+        elif opts.cluster == "sge":
+            from dmlc_core_tpu.tracker import sge as be
+            exit_codes.extend(be.launch(
+                nw, command, envs, queue=opts.queue, jobname=opts.jobname,
+                worker_cores=opts.worker_cores))
+        elif opts.cluster == "slurm":
+            from dmlc_core_tpu.tracker import slurm as be
+            exit_codes.extend(be.launch(
+                nw, command, envs, queue=opts.queue, jobname=opts.jobname,
+                worker_cores=opts.worker_cores, worker_memory_mb=opts.worker_memory))
+        elif opts.cluster == "yarn":
+            from dmlc_core_tpu.tracker import yarn as be
+            exit_codes.extend(be.launch(
+                nw, command, envs, queue=opts.queue, jobname=opts.jobname,
+                worker_cores=opts.worker_cores or 1,
+                worker_memory_mb=opts.worker_memory or 1024))
+        elif opts.cluster == "mesos":
+            from dmlc_core_tpu.tracker import mesos as be
+            exit_codes.extend(be.launch(
+                nw, command, envs, master=opts.mesos_master, jobname=opts.jobname,
+                worker_cores=opts.worker_cores or 1,
+                worker_memory_mb=opts.worker_memory or 1024))
+        elif opts.cluster == "kubernetes":
+            from dmlc_core_tpu.tracker import kubernetes as be
+            CHECK(opts.image is not None, "--cluster kubernetes needs --image")
+            exit_codes.extend(be.launch(
+                nw, command, envs, image=opts.image, jobname=opts.jobname,
+                worker_cores=opts.worker_cores, worker_memory_mb=opts.worker_memory,
+                max_attempts=opts.max_attempts))
 
     tracker = tracker_submit(
         opts.num_workers,

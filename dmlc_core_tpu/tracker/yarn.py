@@ -1,0 +1,68 @@
+"""YARN launch backend.
+
+Reference parity: ``tracker/dmlc_tracker/yarn.py`` + ``tracker/yarn/``
+Java client (SURVEY.md §2c).  The reference ships a Java ApplicationMaster
+that negotiates containers and restarts failed ones up to a max-attempt
+count (its only elastic piece).  This build keeps the Python submission
+surface — constructing the ``hadoop jar`` command with the ``DMLC_*`` ABI
+and resource options — but delegates the AM role to YARN's own
+distributed-shell AM (no vendored Java): per-container restart semantics
+are instead provided by the tracker's ``recover`` command plus
+checkpoint-resume (SURVEY.md §5), which is the TPU-world failure model
+(slice restart, not per-worker elasticity).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+from dmlc_core_tpu.base.logging import CHECK, LOG
+
+__all__ = ["build_command", "launch"]
+
+
+def build_command(
+    nworker: int,
+    command: List[str],
+    envs: Dict[str, str],
+    queue: Optional[str] = None,
+    jobname: str = "dmlc-job",
+    worker_cores: int = 1,
+    worker_memory_mb: int = 1024,
+    hadoop_binary: str = "hadoop",
+    app_jar: Optional[str] = None,
+) -> List[str]:
+    """Construct the YARN distributed-shell submission (pure; for tests).
+
+    ``app_jar`` defaults to ``$HADOOP_HOME``'s distributed-shell jar; the
+    worker command runs once per container with the env ABI exported.
+    """
+    CHECK(len(command) > 0, "yarn.build_command: empty worker command")
+    jar = app_jar or os.path.join(
+        os.environ.get("HADOOP_HOME", "/opt/hadoop"),
+        "share/hadoop/yarn/hadoop-yarn-applications-distributedshell.jar")
+    cmd = [
+        hadoop_binary, "jar", jar,
+        "-jar", jar,
+        "-appname", jobname,
+        "-num_containers", str(nworker),
+        "-container_vcores", str(worker_cores),
+        "-container_memory", str(worker_memory_mb),
+        "-shell_command", " ".join(command),
+    ]
+    if queue:
+        cmd += ["-queue", queue]
+    env = dict(envs)
+    env.setdefault("DMLC_ROLE", "worker")
+    for k, v in sorted(env.items()):
+        cmd += ["-shell_env", f"{k}={v}"]
+    return cmd
+
+
+def launch(nworker: int, command: List[str], envs: Dict[str, str],
+           **kw) -> List[int]:
+    cmd = build_command(nworker, command, envs, **kw)
+    LOG("INFO", "yarn launch: %s", " ".join(cmd))
+    return [subprocess.call(cmd, env=dict(os.environ))]

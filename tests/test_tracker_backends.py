@@ -1,0 +1,139 @@
+"""Tests for the cluster launch backends (SURVEY.md §2c inventory).
+
+All backends expose a pure command/script/manifest builder that is
+asserted here without needing the cluster manager installed — the same
+way the reference's backends are thin cmdline generators over the
+``DMLC_*`` env ABI.
+"""
+
+import json
+import shlex
+
+import pytest
+
+from dmlc_core_tpu.tracker import kubernetes as k8s
+from dmlc_core_tpu.tracker import launcher, mesos, mpi, sge, slurm, yarn
+from dmlc_core_tpu.tracker.opts import CLUSTERS, get_opts
+
+ENVS = {"DMLC_TRACKER_URI": "10.0.0.1", "DMLC_TRACKER_PORT": "9091",
+        "DMLC_NUM_WORKER": "4"}
+CMD = ["python", "worker.py", "--lr", "0.1"]
+
+
+class TestMPI:
+    def test_openmpi_exports_keys(self):
+        cmd = mpi.build_command(4, CMD, ENVS, flavor="openmpi")
+        assert cmd[:3] == ["mpirun", "-n", "4"]
+        assert "-x" in cmd and "DMLC_TRACKER_URI" in cmd
+        assert cmd[-len(CMD):] == CMD
+
+    def test_mpich_inlines_values(self):
+        cmd = mpi.build_command(2, CMD, ENVS, flavor="mpich")
+        i = cmd.index("DMLC_TRACKER_PORT")
+        assert cmd[i - 1] == "-env" and cmd[i + 1] == "9091"
+
+    def test_hostfile_flag(self):
+        cmd = mpi.build_command(2, CMD, ENVS, host_file="hosts.txt", flavor="openmpi")
+        assert "--hostfile" in cmd
+        cmd = mpi.build_command(2, CMD, ENVS, host_file="hosts.txt", flavor="mpich")
+        assert "-f" in cmd
+
+
+class TestSlurm:
+    def test_srun_line(self):
+        cmd = slurm.build_command(8, CMD, ENVS, queue="tpu", jobname="j1",
+                                  worker_cores=4, worker_memory_mb=2048)
+        assert "--ntasks=8" in cmd and "--partition=tpu" in cmd
+        exports = [c for c in cmd if c.startswith("--export=")]
+        assert len(exports) == 1
+        assert "DMLC_TRACKER_URI=10.0.0.1" in exports[0]
+        assert "DMLC_ROLE=worker" in exports[0]
+        assert cmd[-len(CMD):] == CMD
+
+
+class TestSGE:
+    def test_script_structure(self):
+        script = sge.build_script(4, CMD, ENVS, queue="all.q", jobname="j2")
+        assert "#$ -t 1-4" in script
+        assert "#$ -q all.q" in script
+        assert "export DMLC_TRACKER_URI=10.0.0.1" in script
+        assert "DMLC_TASK_ID=$((SGE_TASK_ID - 1))" in script
+        assert shlex.join(CMD) in script or " ".join(CMD) in script
+
+
+class TestYarn:
+    def test_command_resources_and_env(self):
+        cmd = yarn.build_command(4, CMD, ENVS, queue="prod", worker_cores=2,
+                                 worker_memory_mb=4096, app_jar="/x/ds.jar")
+        assert "-num_containers" in cmd and cmd[cmd.index("-num_containers") + 1] == "4"
+        assert "-container_vcores" in cmd and "-container_memory" in cmd
+        assert "-queue" in cmd
+        joined = " ".join(cmd)
+        assert "DMLC_TRACKER_URI=10.0.0.1" in joined
+
+
+class TestMesos:
+    def test_command_env_json(self):
+        cmd = mesos.build_command(3, CMD, ENVS, master="m:5050", worker_cores=2,
+                                  worker_memory_mb=512)
+        env_arg = next(c for c in cmd if c.startswith("--env="))
+        env = json.loads(env_arg[len("--env="):])
+        kv = {e["name"]: e["value"] for e in env["variables"]}
+        assert kv["DMLC_TASK_ID"] == "3"
+        assert kv["DMLC_ROLE"] == "worker"
+        assert "--resources=cpus:2;mem:512" in cmd
+
+
+class TestKubernetes:
+    def test_manifest_indexed_job(self):
+        m = k8s.build_manifest(8, CMD, ENVS, image="img:1", jobname="j3",
+                               worker_cores=4, worker_memory_mb=8192,
+                               tpu_topology="2x4",
+                               tpu_accelerator="tpu-v5-lite-podslice")
+        assert m["kind"] == "Job"
+        spec = m["spec"]
+        assert spec["completions"] == 8 and spec["parallelism"] == 8
+        assert spec["completionMode"] == "Indexed"
+        pod = spec["template"]["spec"]
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+        c = pod["containers"][0]
+        assert c["command"] == CMD
+        names = [e["name"] for e in c["env"]]
+        assert "DMLC_TRACKER_URI" in names and "DMLC_TASK_ID" in names
+        assert c["resources"]["requests"]["memory"] == "8192Mi"
+        json.dumps(m)  # must be serializable for kubectl apply -f -
+
+
+class TestLauncher:
+    def test_task_id_priority(self):
+        assert launcher.task_id_from_env({"DMLC_TASK_ID": "5",
+                                          "SLURM_PROCID": "9"}) == 5
+        assert launcher.task_id_from_env({"OMPI_COMM_WORLD_RANK": "3"}) == 3
+        assert launcher.task_id_from_env({"SLURM_PROCID": "2"}) == 2
+        assert launcher.task_id_from_env({"JOB_COMPLETION_INDEX": "7"}) == 7
+        assert launcher.task_id_from_env({}) == 0
+
+    def test_prepare_env_fills_abi(self):
+        env = launcher.prepare_env({"PMI_RANK": "4"})
+        assert env["DMLC_TASK_ID"] == "4"
+        assert env["DMLC_ROLE"] == "worker"
+        assert env["DMLC_NUM_ATTEMPT"] == "0"
+
+
+class TestOpts:
+    def test_all_reference_clusters_present(self):
+        # SURVEY.md §2c: local, ssh, mpi, sge, slurm, yarn, mesos, kubernetes
+        assert set(CLUSTERS) == {"local", "ssh", "mpi", "sge", "slurm",
+                                 "yarn", "mesos", "kubernetes"}
+
+    @pytest.mark.parametrize("cluster", CLUSTERS)
+    def test_cluster_accepted(self, cluster):
+        opts, cmd = get_opts(["--cluster", cluster, "-n", "2", "--", "echo", "hi"])
+        assert opts.cluster == cluster and cmd == ["echo", "hi"]
+
+    def test_resource_opts(self):
+        opts, _ = get_opts(["-n", "4", "--queue", "q", "--worker-cores", "8",
+                            "--worker-memory", "1024", "--image", "img",
+                            "--max-attempts", "5", "--", "x"])
+        assert (opts.queue, opts.worker_cores, opts.worker_memory,
+                opts.image, opts.max_attempts) == ("q", 8, 1024, "img", 5)
