@@ -10,3 +10,6 @@ Reference parity: ``include/dmlc/data.h`` (Row/RowBlock/Parser/RowBlockIter),
 from dmlc_core_tpu.data.row_block import Row, RowBlock, RowBlockContainer  # noqa: F401
 from dmlc_core_tpu.data.parsers import Parser  # noqa: F401
 from dmlc_core_tpu.data.iter import RowBlockIter  # noqa: F401
+from dmlc_core_tpu.data.device_feed import DeviceFeed, FeedStats  # noqa: F401
+from dmlc_core_tpu.data.image_record import (  # noqa: F401
+    batch_iterator, pack_image_record, unpack_image_record)

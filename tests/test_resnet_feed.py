@@ -1,0 +1,147 @@
+"""Tests for the image-record codec, DeviceFeed infeed, and ResNet trainer
+(BASELINE config 2's pipeline: RecordIO shard → host parse → async
+device staging → jitted data-parallel train step)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlc_core_tpu.data.device_feed import DeviceFeed
+from dmlc_core_tpu.data.image_record import (
+    batch_iterator, pack_image_record, unpack_image_record)
+from dmlc_core_tpu.io.recordio import RecordIOWriter
+from dmlc_core_tpu.io.stream import Stream
+from dmlc_core_tpu.models.resnet import RESNET_STAGES, ResNet, ResNetTrainer
+from dmlc_core_tpu.parallel.mesh import local_mesh
+
+
+def _write_rec(path, n, shape=(8, 8, 3), seed=0):
+    rng = np.random.default_rng(seed)
+    labels = []
+    with RecordIOWriter(Stream.create(path, "w")) as w:
+        for i in range(n):
+            img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+            label = i % 4
+            labels.append(label)
+            w.write_record(pack_image_record(img, label, record_id=i))
+    return labels
+
+
+class TestImageRecord:
+    def test_pack_unpack_round_trip(self, rng):
+        img = rng.integers(0, 256, size=(12, 10, 3), dtype=np.uint8)
+        rec = pack_image_record(img, 7.0, record_id=42)
+        out, label, rid = unpack_image_record(rec)
+        np.testing.assert_array_equal(out, img)
+        assert label == 7.0 and rid == 42
+
+    def test_batch_iterator_shards_cover_all(self, tmp_path):
+        path = os.path.join(tmp_path, "img.rec")
+        _write_rec(path, 64)
+        seen = []
+        for part in range(4):
+            for images, labels in batch_iterator(path, part, 4, 4, (8, 8, 3)):
+                assert images.shape == (4, 8, 8, 3) and labels.shape == (4,)
+                seen.extend(labels.tolist())
+        assert len(seen) == 64  # full coverage, no overlap
+        assert sorted(set(seen)) == [0, 1, 2, 3]
+
+    def test_drop_last_and_partial(self, tmp_path):
+        path = os.path.join(tmp_path, "img.rec")
+        _write_rec(path, 10)
+        full = list(batch_iterator(path, 0, 1, 4, (8, 8, 3), drop_last=True))
+        assert len(full) == 2
+        both = list(batch_iterator(path, 0, 1, 4, (8, 8, 3), drop_last=False))
+        assert len(both) == 3 and both[-1][0].shape[0] == 2
+
+
+class TestDeviceFeed:
+    def test_yields_sharded_arrays_and_rewinds(self):
+        mesh = local_mesh()
+        sh = NamedSharding(mesh, P("data"))
+
+        def host_iter():
+            for i in range(5):
+                yield np.full(16, i, np.float32)
+
+        with DeviceFeed(host_iter, sh, depth=2) as feed:
+            vals = [float(np.asarray(b)[0]) for b in feed]
+            assert vals == [0, 1, 2, 3, 4]
+            assert feed.stats.batches == 5
+            assert feed.stats.bytes == 5 * 16 * 4
+            # second epoch after rewind
+            vals2 = [float(np.asarray(b)[0]) for b in feed]
+            assert vals2 == vals
+
+    def test_pytree_batches_with_mesh_shorthand(self):
+        mesh = local_mesh()
+
+        def host_iter():
+            yield (np.zeros((8, 4), np.float32), np.arange(8, dtype=np.int32))
+
+        with DeviceFeed(host_iter, mesh) as feed:
+            x, y = next(iter(feed))
+            assert x.sharding.spec == P("data", None)
+            assert np.asarray(y).tolist() == list(range(8))
+
+    def test_producer_exception_propagates(self):
+        mesh = local_mesh()
+
+        def host_iter():
+            yield np.zeros(8, np.float32)
+            raise ValueError("boom in parser")
+
+        with DeviceFeed(host_iter, mesh) as feed, pytest.raises(ValueError):
+            for _ in feed:
+                pass
+
+
+class TestResNet:
+    def test_forward_shapes_all_variants_config(self):
+        # construct (not run) every variant; run the micro one
+        for name, (stages, bottleneck) in RESNET_STAGES.items():
+            m = ResNet(stage_sizes=stages, bottleneck=bottleneck, num_classes=10)
+            assert m.stage_sizes == stages
+        m = ResNet(stage_sizes=(1, 1), bottleneck=False, num_classes=4,
+                   num_filters=8)
+        x = np.zeros((2, 16, 16, 3), np.uint8)
+        variables = m.init(jax.random.key(0), x, train=False)
+        logits = m.apply(variables, x, train=False)
+        assert logits.shape == (2, 4)
+        assert logits.dtype == np.float32
+
+    def test_end_to_end_training_from_recordio(self, tmp_path):
+        """Config 2 in miniature: labels are recoverable from the images
+        (label encoded in pixel intensity), loss must fall."""
+        path = os.path.join(tmp_path, "train.rec")
+        rng = np.random.default_rng(3)
+        with RecordIOWriter(Stream.create(path, "w")) as w:
+            for i in range(128):
+                label = i % 4
+                img = np.clip(rng.normal(label * 60 + 30, 10, size=(8, 8, 3)),
+                              0, 255).astype(np.uint8)
+                w.write_record(pack_image_record(img, label))
+        tr = ResNetTrainer(variant="resnet-micro", num_classes=4,
+                           learning_rate=0.05, mesh=local_mesh())
+        tr.init((8, 8, 3))
+        first = None
+        for _ in range(3):
+            stats = tr.fit_from_records(path, batch_size=16,
+                                        image_shape=(8, 8, 3))
+            if first is None:
+                first = stats["last_loss"]
+        assert stats["steps"] == 8
+        assert stats["records"] == 128
+        assert stats["records_per_sec"] > 0
+        assert 0.0 <= stats["infeed_stall_fraction"] <= 1.0
+        assert stats["last_loss"] < first, (first, stats["last_loss"])
+
+    def test_param_validation(self):
+        from dmlc_core_tpu.base.logging import Error
+
+        with pytest.raises(Error):
+            ResNetTrainer(variant="resnet9000")
