@@ -44,7 +44,7 @@ from dmlc_core_tpu.base.parameter import get_env
 __all__ = [
     "init", "finalize", "rank", "world_size", "is_distributed",
     "allreduce", "broadcast", "allgather", "barrier",
-    "device_allreduce", "device_allgather",
+    "device_allreduce", "device_allgather", "replicate_fwd_psum_bwd",
     "get_tree", "find_share_ring", "get_link_map",
 ]
 
@@ -209,6 +209,33 @@ def device_allreduce(x: jax.Array, mesh: Mesh, op: str = "sum",
         return lax_op(local_op(shard, axis=0), axis)
 
     return jax.jit(_reduce)(x)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def replicate_fwd_psum_bwd(x: jax.Array, axis: str) -> jax.Array:
+    """Identity forward, ``psum`` over ``axis`` backward (Megatron's *f*).
+
+    Marks the boundary where a replicated activation enters computation
+    sharded over ``axis`` (tensor parallelism): the forward is free, and
+    the backward all-reduces the partial cotangents so every shard holds
+    the COMPLETE gradient.  Without it, parameters upstream of the
+    boundary would see only their shard's contribution — and a blanket
+    per-parameter psum instead double-counts the residual-stream path.
+    Use inside shard_map.
+    """
+    return x
+
+
+def _rfpb_fwd(x, axis):
+    del axis
+    return x, None
+
+
+def _rfpb_bwd(axis, _res, ct):
+    return (jax.lax.psum(ct, axis),)
+
+
+replicate_fwd_psum_bwd.defvjp(_rfpb_fwd, _rfpb_bwd)
 
 
 def device_allgather(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:

@@ -43,12 +43,18 @@ class KVStore:
     ``learning_rate`` (so push/pull alone implements dist-SGD).
     """
 
-    def __init__(self, kv_type: str = "local", learning_rate: float = 0.1):
+    def __init__(self, kv_type: str = "local", learning_rate: float = 0.1,
+                 mesh: Optional[Any] = None, axis: str = "data"):
         CHECK(kv_type in ("local", "dist_sync"), f"unknown kvstore type {kv_type!r}")
         self.type = kv_type
         self._store: Dict[Key, jax.Array] = {}
         self._pending: Dict[Key, jax.Array] = {}
         self._lr = learning_rate
+        # in-mesh dist_sync: "workers" are the shards along ``axis`` of
+        # ``mesh``; pushed values carry a leading worker dim sharded on
+        # that axis and pull reduces it with one XLA AllReduce (config 4)
+        self._mesh = mesh
+        self._axis = axis
         self._updater: Callable[[Key, jax.Array, jax.Array], jax.Array] = (
             lambda key, grad, value: value - self._lr * grad
         )
@@ -87,8 +93,12 @@ class KVStore:
             self._check_key(k)
             if k in self._pending:
                 grad = self._pending.pop(k)
-                if self.type == "dist_sync" and coll.world_size() > 1:
-                    grad = jnp.asarray(coll.allreduce(np.asarray(grad), "sum"))
+                if self.type == "dist_sync":
+                    if self._mesh is not None:
+                        grad = coll.device_allreduce(grad, self._mesh, "sum",
+                                                     axis=self._axis)
+                    elif coll.world_size() > 1:
+                        grad = jnp.asarray(coll.allreduce(np.asarray(grad), "sum"))
                 self._store[k] = self._updater(k, grad, self._store[k])
         out = [self._store[k] for k in key_list]
         return out[0] if single else out

@@ -1,0 +1,107 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context support is first-class in this framework (the substrate
+obligation SURVEY.md §5 notes: the data plane already streams unbounded
+records; this module is the compute-side counterpart).  The sequence axis
+of a mesh (``seq``) shards tokens across devices; full attention then
+needs every (query, key) pair, which ring attention provides without ever
+materializing the full sequence on one chip:
+
+* each device holds local blocks ``q/k/v [B, S/P, H, D]``;
+* K/V blocks rotate around the ``seq`` axis with ``lax.ppermute`` — P
+  steps over the ICI ring, communication overlapped with the block
+  attention compute;
+* softmax is accumulated **online** (flash-attention style running max /
+  normalizer), so the result is exact, not approximate — bf16 inputs,
+  f32 accumulation on the MXU.
+
+Designed for use inside ``shard_map`` (see :func:`ring_attention`'s
+contract) and composed by the BERT family for sequence parallelism; causal
+masking uses global token positions so decoder stacks shard identically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "reference_attention"]
+
+
+def ring_attention(
+    q: jax.Array,           # [B, S_local, H, D] — this device's query block
+    k: jax.Array,           # [B, S_local, H, D]
+    v: jax.Array,           # [B, S_local, H, D]
+    axis_name: str = "seq",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over the sequence axis ``axis_name``.
+
+    MUST be called inside a ``shard_map`` (or pmap) that maps the token
+    dimension over ``axis_name``.  Returns this device's output block
+    ``[B, S_local, H, D]``.
+    """
+    n_dev = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = my_idx * s_local + jnp.arange(s_local)               # global positions
+
+    def one_block(k_blk, v_blk, src_idx):
+        """Attention of local q against one rotated K/V block."""
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src_idx * s_local + jnp.arange(s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]              # [Sq, Sk]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        return s
+
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def body(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # after i rotations this device holds the block born on (my_idx - i)
+        src_idx = (my_idx - i) % n_dev
+        s = one_block(k_blk, v_blk, src_idx)                     # [B,H,Sq,Sk]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard fully-masked rows: exp(-inf - (-inf)) → use finite floor
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), m, m - m_safe))
+        p = jnp.exp(s - m_safe[..., None])
+        if causal:
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = (o * corr[..., None]
+                 + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)))
+        k_rot = lax.ppermute(k_blk, axis_name, perm)
+        v_rot = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_rot, v_rot), None
+
+    B, S, H, D = q.shape
+    o0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    (o, _m, l, _k, _v), _ = lax.scan(
+        body, (o0, m0, l0, k, v), jnp.arange(n_dev))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)      # [B,S,H,D]
+
+
+def reference_attention(q, k, v, causal: bool = False, scale=None):
+    """Single-device oracle (full softmax) for tests."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk",
+                   q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        S = q.shape[1]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

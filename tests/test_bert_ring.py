@@ -1,0 +1,104 @@
+"""Tests for ring attention and the BERT dp×tp×sp trainer (config 4).
+
+Oracles: single-device full-softmax attention; sharded-equals-replicated
+training (the tp/sp/dp correctness check); KVStore dist_sync vs fused
+psum equivalence on the first step."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dmlc_core_tpu.models.bert import BERT
+from dmlc_core_tpu.parallel.mesh import MeshSpec, create_mesh, local_mesh
+from dmlc_core_tpu.parallel.ring_attention import (
+    reference_attention, ring_attention)
+
+TINY = dict(n_layers=2, d_model=32, n_heads=4, d_ff=64, vocab_size=64,
+            max_len=32, learning_rate=0.1)
+
+
+def _batch(B=4, S=32, V=64, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, V, size=(B, S))
+    mask = (rng.uniform(size=(B, S)) < 0.3).astype(np.float32)
+    mask[:, 0] = 1.0  # never fully unmasked
+    return tokens, tokens.copy(), mask
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("n_seq", [2, 4, 8])
+    def test_matches_full_softmax(self, causal, n_seq, rng):
+        mesh = Mesh(np.asarray(jax.devices()[:n_seq]), ("seq",))
+        B, S, H, D = 2, 8 * n_seq, 3, 8
+        q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+                   for _ in range(3))
+        f = jax.jit(shard_map(
+            partial(ring_attention, axis_name="seq", causal=causal),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False))
+        out = np.asarray(f(q, k, v))
+        ref = np.asarray(reference_attention(q, k, v, causal=causal))
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_single_device_axis(self, rng):
+        # size-1 seq axis: ring degenerates to local attention
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("seq",))
+        q = jnp.asarray(rng.normal(size=(1, 8, 2, 4)).astype(np.float32))
+        f = jax.jit(shard_map(partial(ring_attention, axis_name="seq"),
+                              mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+                              out_specs=P(None, "seq"), check_vma=False))
+        np.testing.assert_allclose(np.asarray(f(q, q, q)),
+                                   np.asarray(reference_attention(q, q, q)),
+                                   atol=2e-5)
+
+
+class TestBERT:
+    def test_trains_and_loss_decreases(self):
+        mesh = create_mesh(MeshSpec(data=2, model=2, seq=2))
+        m = BERT(mesh=mesh, **TINY)
+        m.init_params(0)
+        tokens, labels, mask = _batch()
+        losses = [m.train_step(tokens, labels, mask) for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+    def test_sharded_equals_replicated(self):
+        """THE tp/sp/dp oracle: an 8-way (2,2,2) mesh must reproduce the
+        1-device loss trajectory (bf16 tolerance)."""
+        tokens, labels, mask = _batch(seed=5)
+        trajs = []
+        for mesh in (create_mesh(MeshSpec(data=2, model=2, seq=2)),
+                     local_mesh(1)):
+            m = BERT(mesh=mesh, **TINY)
+            m.init_params(7)
+            trajs.append([m.train_step(tokens, labels, mask) for _ in range(4)])
+        np.testing.assert_allclose(trajs[0], trajs[1], rtol=2e-2)
+
+    def test_kvstore_first_step_matches_fused(self):
+        mesh = create_mesh(MeshSpec(data=4, seq=2))
+        tokens, labels, mask = _batch(seed=2)
+        lf = BERT(mesh=mesh, grad_sync="fused", **TINY)
+        lf.init_params(3)
+        lk = BERT(mesh=mesh, grad_sync="kvstore", **TINY)
+        lk.init_params(3)
+        # loss is computed before the update → step-0 losses match exactly
+        assert lf.train_step(tokens, labels, mask) == pytest.approx(
+            lk.train_step(tokens, labels, mask), rel=1e-5)
+        # and the *second* losses agree too (kvstore = plain SGD vs fused
+        # SGD-momentum: first update identical, so second loss matches)
+        assert lf.train_step(tokens, labels, mask) == pytest.approx(
+            lk.train_step(tokens, labels, mask), rel=2e-2)
+
+    def test_head_divisibility_validated(self):
+        from dmlc_core_tpu.base.logging import Error
+
+        mesh = create_mesh(MeshSpec(data=2, model=4))
+        with pytest.raises(Error):
+            BERT(mesh=mesh, n_layers=1, d_model=24, n_heads=6, d_ff=32,
+                 vocab_size=32, max_len=16)
