@@ -1,0 +1,154 @@
+// Native RecordIO framing hot loop for dmlc_core_tpu.
+//
+// Reference parity: include/dmlc/recordio.h + src/recordio.cc ::
+// RecordIOWriter/RecordIOChunkReader (SURVEY.md §2a).  Wire format:
+//   [magic:u32le][lrec:u32le][payload][0-pad to 4]
+//   lrec = (cflag << 29) | length, cflag ∈ {0 whole, 1 start, 2 mid, 3 end};
+//   payloads containing the magic u32 at an aligned offset are split there
+//   (magic consumed by the writer, re-inserted by the reader).
+//
+// The Python layer (dmlc_core_tpu/io/recordio.py) implements the same
+// format; these entry points are the batch fast paths used by the RecordIO
+// chunk decode (TPU infeed, BASELINE config 2) and bulk writers.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230aU;
+constexpr int64_t kMaxLen = (int64_t(1) << 29) - 1;
+
+inline uint32_t ReadU32(const char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;  // little-endian hosts only (TPU hosts are x86/ARM LE)
+}
+
+inline void AppendU32(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Shared growable result buffer.  For decode: `data` is the concatenated
+// record payloads and `offsets` has n+1 entries framing each record.  For
+// encode: `data` is the framed byte stream (offsets unused, n = records).
+typedef struct {
+  char* data;
+  int64_t len;
+  int64_t* offsets;
+  int64_t n;
+  char error[256];
+} DmlcBuf;
+
+void dmlc_buf_free(DmlcBuf* b) {
+  if (b == nullptr) return;
+  std::free(b->data);
+  std::free(b->offsets);
+  b->data = nullptr;
+  b->offsets = nullptr;
+  b->len = b->n = 0;
+}
+
+static int fail(DmlcBuf* out, const char* msg) {
+  std::snprintf(out->error, sizeof(out->error), "%s", msg);
+  return 1;
+}
+
+static char* copy_out(const std::string& s) {
+  char* p = static_cast<char*>(std::malloc(s.size() ? s.size() : 1));
+  if (p != nullptr) std::memcpy(p, s.data(), s.size());
+  return p;
+}
+
+// Frame `n` records (concatenated in `data`, framed by `offsets[n+1]`) into
+// a RecordIO byte stream.
+int dmlc_recordio_encode(const char* data, const int64_t* offsets, int64_t n,
+                         DmlcBuf* out) {
+  std::memset(out, 0, sizeof(*out));
+  std::string buf;
+  buf.reserve(static_cast<size_t>(offsets[n] - offsets[0]) + 16 * n);
+  for (int64_t r = 0; r < n; ++r) {
+    const char* rec = data + offsets[r];
+    const int64_t size = offsets[r + 1] - offsets[r];
+    if (size < 0 || size > kMaxLen) return fail(out, "record too large");
+    const int64_t lower = (size >> 2) << 2;
+    const int64_t upper = ((size + 3) >> 2) << 2;
+    int64_t dptr = 0;
+    // split payload at 4-byte-aligned embedded magics (magic consumed)
+    for (int64_t pos = 0; pos + 4 <= lower; pos += 4) {
+      if (ReadU32(rec + pos) == kMagic) {
+        const uint32_t cflag = (dptr == 0) ? 1 : 2;
+        AppendU32(&buf, kMagic);
+        AppendU32(&buf, (cflag << 29) | uint32_t(pos - dptr));
+        buf.append(rec + dptr, pos - dptr);
+        dptr = pos + 4;
+      }
+    }
+    const uint32_t cflag = (dptr != 0) ? 3 : 0;
+    AppendU32(&buf, kMagic);
+    AppendU32(&buf, (cflag << 29) | uint32_t(size - dptr));
+    buf.append(rec + dptr, size - dptr);
+    buf.append(static_cast<size_t>(upper - size), '\0');
+  }
+  out->data = copy_out(buf);
+  if (out->data == nullptr) return fail(out, "out of memory");
+  out->len = static_cast<int64_t>(buf.size());
+  out->n = n;
+  return 0;
+}
+
+// Decode a chunk of complete RecordIO records into concatenated payloads +
+// offsets.  The chunk must contain only whole parts (the InputSplit carry
+// logic guarantees this).
+int dmlc_recordio_decode(const char* chunk, int64_t len, DmlcBuf* out) {
+  std::memset(out, 0, sizeof(*out));
+  std::string payload;
+  payload.reserve(static_cast<size_t>(len));
+  std::vector<int64_t> offsets;
+  offsets.push_back(0);
+  int64_t pos = 0;
+  bool in_record = false;
+  while (pos < len) {
+    if (pos + 8 > len) return fail(out, "truncated header");
+    if (ReadU32(chunk + pos) != kMagic) return fail(out, "bad magic");
+    const uint32_t lrec = ReadU32(chunk + pos + 4);
+    const uint32_t cflag = (lrec >> 29) & 7;
+    const int64_t clen = lrec & kMaxLen;
+    if (pos + 8 + clen > len) return fail(out, "truncated payload");
+    if ((cflag == 0 || cflag == 1) && in_record)
+      return fail(out, "unexpected record start flag");
+    if ((cflag == 2 || cflag == 3) && !in_record)
+      return fail(out, "unexpected continuation flag");
+    if (cflag == 2 || cflag == 3)
+      payload.append(reinterpret_cast<const char*>(&kMagic), 4);
+    payload.append(chunk + pos + 8, static_cast<size_t>(clen));
+    pos += 8 + (((clen + 3) >> 2) << 2);
+    if (cflag == 0 || cflag == 3) {
+      offsets.push_back(static_cast<int64_t>(payload.size()));
+      in_record = false;
+    } else {
+      in_record = true;
+    }
+  }
+  if (in_record) return fail(out, "truncated multi-part record");
+  out->data = copy_out(payload);
+  out->offsets = static_cast<int64_t*>(
+      std::malloc(offsets.size() * sizeof(int64_t)));
+  if (out->data == nullptr || out->offsets == nullptr) {
+    dmlc_buf_free(out);
+    return fail(out, "out of memory");
+  }
+  std::memcpy(out->offsets, offsets.data(), offsets.size() * sizeof(int64_t));
+  out->len = static_cast<int64_t>(payload.size());
+  out->n = static_cast<int64_t>(offsets.size()) - 1;
+  return 0;
+}
+
+}  // extern "C"

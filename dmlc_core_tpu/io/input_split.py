@@ -176,9 +176,41 @@ class InputSplitBase(InputSplit):
         self._pos = self._begin
         self._carry = b""
         self._pending: _deque = _deque()
+        self._restart_native_reader()
+
+    # -- native prefetch fast path ---------------------------------------
+    def _restart_native_reader(self) -> None:
+        """(Re)start the native threaded chunk reader (cpp/prefetch.cc) when
+        the backend is local files — the C++ counterpart of the reference's
+        ``ThreadedInputSplit`` storage-read thread.  Produces the identical
+        chunk sequence to the Python ``_read_at`` loop below."""
+        old = getattr(self, "_native", None)
+        if old is not None:
+            old.close()
+        self._native = None
+        self._native_fidx: List[int] = []
+        from dmlc_core_tpu.io import _native_io
+        from dmlc_core_tpu.io.filesystem import LocalFileSystem
+
+        if (not isinstance(self._fs, LocalFileSystem)
+                or not _native_io.native_io_available()
+                or self._begin >= self._end):
+            return
+        segments = []
+        for fidx in range(len(self._files)):
+            lo = max(self._begin, self._cum[fidx])
+            hi = min(self._end, self._cum[fidx + 1])
+            if lo < hi:
+                segments.append((URI(self._files[fidx].path).name,
+                                 lo - self._cum[fidx], hi - self._cum[fidx]))
+                self._native_fidx.append(fidx)
+        if segments:
+            self._native = _native_io.NativeChunkReader(segments, self._chunk_size)
 
     def hint_chunk_size(self, nbytes: int) -> None:
         self._chunk_size = max(nbytes, 4096)
+        if getattr(self, "_native", None) is not None and self._pos == self._begin:
+            self._restart_native_reader()  # not yet consumed: re-chunk
 
     def _find_file(self, offset: int) -> int:
         """Index of the file containing global ``offset``."""
@@ -245,11 +277,18 @@ class InputSplitBase(InputSplit):
                     log_fatal("InputSplit: partial record at aligned range end "
                               "(corrupt input?)")
                 return None
-            fidx = self._find_file(self._pos)
-            want = min(self._chunk_size, self._end - self._pos)
-            data = self._read_at(self._pos, want)
-            if not data:
-                log_fatal("InputSplit: short read inside assigned range")
+            if self._native is not None:
+                item = self._native.next()
+                if item is None:
+                    log_fatal("InputSplit: short read inside assigned range")
+                fidx = self._native_fidx[item[0]]
+                data = item[1]
+            else:
+                fidx = self._find_file(self._pos)
+                want = min(self._chunk_size, self._end - self._pos)
+                data = self._read_at(self._pos, want)
+                if not data:
+                    log_fatal("InputSplit: short read inside assigned range")
             self._pos += len(data)
             if self._carry:
                 data = self._carry + data
@@ -282,6 +321,9 @@ class InputSplitBase(InputSplit):
         raise NotImplementedError
 
     def close(self) -> None:
+        if getattr(self, "_native", None) is not None:
+            self._native.close()
+            self._native = None
         if self._stream is not None:
             self._stream.close()
             self._stream = None
@@ -382,7 +424,9 @@ class RecordIOSplit(InputSplitBase):
         return b"".join(recs)
 
     def _records_from_chunk(self, chunk: bytes) -> List[bytes]:
-        return list(RecordIOChunkReader(chunk))
+        from dmlc_core_tpu.io.recordio import decode_chunk
+
+        return decode_chunk(chunk)
 
 
 class SingleFileSplit(InputSplit):
@@ -504,14 +548,9 @@ class IndexedRecordIOSplit(InputSplit):
         recs = self.next_batch(self._batch_size)
         if not recs:
             return None
-        from dmlc_core_tpu.io.memory_io import MemoryStringStream
-        from dmlc_core_tpu.io.recordio import RecordIOWriter
+        from dmlc_core_tpu.io.recordio import encode_records
 
-        buf = MemoryStringStream()
-        w = RecordIOWriter(buf)
-        for r in recs:
-            w.write_record(r)
-        return bytes(buf.data)
+        return encode_records(recs)
 
     def close(self) -> None:
         if self._stream is not None:

@@ -25,7 +25,6 @@ sockets.  bf16 compute, f32 master weights and reductions.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -274,6 +273,13 @@ class BERT:
                    mask: np.ndarray) -> float:
         """One masked-LM step on global [B, S] int32 batches."""
         CHECK(self.params is not None, "call init_params() first")
+        # out-of-range S or token ids would be silently clamped/clipped by
+        # dynamic_slice / jnp.take inside jit — fail loudly on the host side
+        CHECK(tokens.shape[-1] <= self.param.max_len,
+              f"sequence length {tokens.shape[-1]} exceeds max_len "
+              f"{self.param.max_len}")
+        CHECK(int(np.max(tokens)) < self.param.vocab_size,
+              "token id out of vocab range")
         seq_ax = "seq" if self._has_seq else None
         sh = NamedSharding(self.mesh, P("data", seq_ax))
         t = jax.device_put(np.asarray(tokens, np.int32), sh)

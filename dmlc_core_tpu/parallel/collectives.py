@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import atexit
 import os
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -187,6 +187,25 @@ _LAX_REDUCE = {
 }
 
 
+@lru_cache(maxsize=None)
+def _jitted_allreduce(mesh: Mesh, op: str, axis: str):
+    """One stable jitted reducer per (mesh, op, axis).
+
+    jax.jit caches compilations by function identity + input avals, so
+    returning the SAME jitted callable here means repeated calls (e.g. the
+    KVStore pulling every gradient key each step) hit the jit cache instead
+    of retracing and recompiling per call.
+    """
+    lax_op = _LAX_REDUCE[op]
+    local_op = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def _reduce(shard):
+        return lax_op(local_op(shard, axis=0), axis)
+
+    return jax.jit(_reduce)
+
+
 def device_allreduce(x: jax.Array, mesh: Mesh, op: str = "sum",
                      axis: str = "data") -> jax.Array:
     """Allreduce per-device shards over a mesh axis, on-device.
@@ -201,14 +220,7 @@ def device_allreduce(x: jax.Array, mesh: Mesh, op: str = "sum",
     """
     if op not in _LAX_REDUCE:
         log_fatal(f"device_allreduce: unknown op {op!r}")
-    lax_op = _LAX_REDUCE[op]
-    local_op = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
-
-    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
-    def _reduce(shard):
-        return lax_op(local_op(shard, axis=0), axis)
-
-    return jax.jit(_reduce)(x)
+    return _jitted_allreduce(mesh, op, axis)(x)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -238,14 +250,18 @@ def _rfpb_bwd(axis, _res, ct):
 replicate_fwd_psum_bwd.defvjp(_rfpb_fwd, _rfpb_bwd)
 
 
-def device_allgather(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
-    """All-gather shards over a mesh axis (XLA AllGather on ICI)."""
-
+@lru_cache(maxsize=None)
+def _jitted_allgather(mesh: Mesh, axis: str):
     @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
     def _gather(shard):
         return jax.lax.all_gather(shard, axis, tiled=True)
 
-    return jax.jit(_gather)(x)
+    return jax.jit(_gather)
+
+
+def device_allgather(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
+    """All-gather shards over a mesh axis (XLA AllGather on ICI)."""
+    return _jitted_allgather(mesh, axis)(x)
 
 
 # ---------------------------------------------------------------------------
