@@ -27,3 +27,9 @@ from dmlc_core_tpu.io.recordio import (  # noqa: F401
     RECORDIO_MAGIC,
 )
 from dmlc_core_tpu.io.input_split import InputSplit  # noqa: F401
+
+# remote backends self-register their URI protocols on import
+from dmlc_core_tpu.io.s3_filesys import S3FileSystem  # noqa: F401
+from dmlc_core_tpu.io.hdfs_filesys import HDFSFileSystem  # noqa: F401
+from dmlc_core_tpu.io.azure_filesys import AzureFileSystem  # noqa: F401
+from dmlc_core_tpu.io.gcs_filesys import GCSFileSystem  # noqa: F401

@@ -1,0 +1,186 @@
+"""Shared HTTP plumbing for remote filesystem backends (S3/WebHDFS/Azure/GCS).
+
+The reference's remote backends (``src/io/s3_filesys.cc`` etc., SURVEY.md
+§2b) are libcurl-based; here the transport is stdlib ``urllib`` so the
+backends work with zero extra dependencies, and every backend is testable
+against an in-process fake server via its ``*_ENDPOINT`` env override.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+from dmlc_core_tpu.base.logging import log_fatal
+from dmlc_core_tpu.io.stream import SeekStream, Stream
+
+__all__ = ["http_request", "HttpError", "RangedReadStream", "BufferedWriteStream"]
+
+# sign(method, url, headers, payload) -> headers to actually send
+SignFn = Callable[[str, str, Dict[str, str], bytes], Dict[str, str]]
+
+
+class HttpError(IOError):
+    def __init__(self, status: int, url: str, body: bytes = b""):
+        # strip the query string: it can carry credentials (Azure SAS sig=,
+        # WebHDFS user.name) that must not leak into logs/tracebacks
+        safe_url = url.split("?", 1)[0]
+        super().__init__(f"HTTP {status} for {safe_url}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+class _NoRedirect(urllib.request.HTTPErrorProcessor):
+    """Leave 3xx responses to the caller (WebHDFS two-step writes)."""
+
+    def http_response(self, request, response):  # noqa: N802
+        return response
+
+    https_response = http_response
+
+
+_opener = urllib.request.build_opener(_NoRedirect)
+
+
+def http_request(
+    method: str,
+    url: str,
+    headers: Optional[Dict[str, str]] = None,
+    body: bytes = b"",
+    ok: Tuple[int, ...] = (200, 201, 204, 206),
+    follow_redirects: bool = True,
+) -> Tuple[int, Dict[str, str], bytes]:
+    """One HTTP round trip → (status, lowercase headers, body).
+
+    Raises :class:`HttpError` for statuses outside ``ok`` (redirects are
+    returned, not raised, when ``follow_redirects`` is False).
+    """
+    req = urllib.request.Request(url, data=body if body else None,
+                                 method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    opener = urllib.request.build_opener() if follow_redirects else _opener
+    try:
+        with opener.open(req, timeout=60) as resp:
+            status = resp.status
+            hdrs = {k.lower(): v for k, v in resp.headers.items()}
+            data = resp.read()
+    except urllib.error.HTTPError as e:  # raised by the default opener
+        status, hdrs, data = e.code, {k.lower(): v for k, v in e.headers.items()}, e.read()
+    if status in ok or (not follow_redirects and 300 <= status < 400):
+        return status, hdrs, data
+    raise HttpError(status, url, data)
+
+
+class RangedReadStream(SeekStream):
+    """SeekStream over HTTP ranged GETs with a readahead buffer.
+
+    ``url_fn()`` yields the object URL and ``sign`` (optional) produces
+    per-request auth headers — each backend supplies its own.  Reads fetch
+    ``max(want, readahead)`` bytes per round trip, mirroring the reference
+    S3 stream's buffered reads.
+    """
+
+    def __init__(self, url: str, size: int, sign: Optional[SignFn] = None,
+                 readahead: int = 1 << 20,
+                 range_header: str = "Range"):
+        self._url = url
+        self._size = size
+        self._sign = sign
+        self._readahead = readahead
+        self._range_header = range_header
+        self._pos = 0
+        self._buf = b""
+        self._buf_start = 0
+
+    def read(self, nbytes: int) -> bytes:
+        if nbytes < 0:
+            nbytes = self._size - self._pos
+        nbytes = min(nbytes, self._size - self._pos)
+        if nbytes <= 0:
+            return b""
+        # serve from buffer when possible
+        boff = self._pos - self._buf_start
+        if 0 <= boff < len(self._buf):
+            out = self._buf[boff:boff + nbytes]
+            self._pos += len(out)
+            if len(out) == nbytes:
+                return out
+            return out + self.read(nbytes - len(out))
+        fetch = min(max(nbytes, self._readahead), self._size - self._pos)
+        data = self._fetch(self._pos, fetch)
+        if not data:
+            log_fatal(f"RangedReadStream: empty ranged response")
+        self._buf = data
+        self._buf_start = self._pos
+        out = data[:nbytes]
+        self._pos += len(out)
+        return out
+
+    def _fetch(self, pos: int, nbytes: int) -> bytes:
+        """One ranged round trip — the only part backends override."""
+        headers = {self._range_header: f"bytes={pos}-{pos + nbytes - 1}"}
+        if self._sign is not None:
+            headers = self._sign("GET", self._url, headers, b"")
+        status, _, data = http_request("GET", self._url, headers)
+        if status == 200 and len(data) > nbytes:
+            # server ignored Range: slice what we asked for
+            data = data[pos:pos + nbytes]
+        return data
+
+    def write(self, data: bytes) -> int:
+        log_fatal("read-only stream")
+
+    def seek(self, pos: int) -> None:
+        self._pos = pos
+
+    def tell(self) -> int:
+        return self._pos
+
+
+class BufferedWriteStream(Stream):
+    """Write stream that buffers and commits on close (or streams parts).
+
+    Subclasses override :meth:`_commit` (whole-object upload) and may
+    override :meth:`_flush_part` to stream fixed-size parts (S3 multipart).
+    ``part_size <= 0`` disables part streaming.
+    """
+
+    def __init__(self, part_size: int = 0):
+        self._chunks: list = []
+        self._buffered = 0
+        self._part_size = part_size
+        self._closed = False
+
+    def read(self, nbytes: int) -> bytes:
+        log_fatal("write-only stream")
+
+    def write(self, data: bytes) -> int:
+        self._chunks.append(bytes(data))
+        self._buffered += len(data)
+        if self._part_size > 0:
+            while self._buffered >= self._part_size:
+                blob = b"".join(self._chunks)
+                part, rest = blob[:self._part_size], blob[self._part_size:]
+                self._chunks = [rest] if rest else []
+                self._buffered = len(rest)
+                self._flush_part(part)
+        return len(data)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._finish(b"".join(self._chunks))
+        self._chunks = []
+
+    # -- backend hooks ---------------------------------------------------
+    def _flush_part(self, part: bytes) -> None:
+        raise NotImplementedError
+
+    def _finish(self, tail: bytes) -> None:
+        self._commit(tail)
+
+    def _commit(self, data: bytes) -> None:
+        raise NotImplementedError

@@ -176,24 +176,34 @@ class InputSplitBase(InputSplit):
         self._pos = self._begin
         self._carry = b""
         self._pending: _deque = _deque()
-        self._restart_native_reader()
+        self._stop_native_reader()
 
     # -- native prefetch fast path ---------------------------------------
-    def _restart_native_reader(self) -> None:
-        """(Re)start the native threaded chunk reader (cpp/prefetch.cc) when
-        the backend is local files — the C++ counterpart of the reference's
-        ``ThreadedInputSplit`` storage-read thread.  Produces the identical
-        chunk sequence to the Python ``_read_at`` loop below."""
+    def _stop_native_reader(self) -> None:
         old = getattr(self, "_native", None)
         if old is not None:
             old.close()
         self._native = None
+        self._native_started = False
+
+    def _ensure_native_reader(self) -> None:
+        """Lazily start the native threaded chunk reader (cpp/prefetch.cc)
+        on the first real read — the C++ counterpart of the reference's
+        ``ThreadedInputSplit`` storage-read thread.  Lazy start (like
+        ``ThreadedInputSplit``) so ``hint_chunk_size`` lands before the
+        producer begins and unconsumed splits never spawn a thread.
+        Produces the identical chunk sequence to the Python ``_read_at``
+        loop in :meth:`next_chunk`."""
+        if self._native_started:
+            return
+        self._native_started = True
         self._native_fidx: List[int] = []
         from dmlc_core_tpu.io import _native_io
         from dmlc_core_tpu.io.filesystem import LocalFileSystem
 
         if (not isinstance(self._fs, LocalFileSystem)
                 or not _native_io.native_io_available()
+                or self._pos != self._begin  # mid-range: stay on Python path
                 or self._begin >= self._end):
             return
         segments = []
@@ -209,8 +219,6 @@ class InputSplitBase(InputSplit):
 
     def hint_chunk_size(self, nbytes: int) -> None:
         self._chunk_size = max(nbytes, 4096)
-        if getattr(self, "_native", None) is not None and self._pos == self._begin:
-            self._restart_native_reader()  # not yet consumed: re-chunk
 
     def _find_file(self, offset: int) -> int:
         """Index of the file containing global ``offset``."""
@@ -277,6 +285,7 @@ class InputSplitBase(InputSplit):
                     log_fatal("InputSplit: partial record at aligned range end "
                               "(corrupt input?)")
                 return None
+            self._ensure_native_reader()
             if self._native is not None:
                 item = self._native.next()
                 if item is None:
@@ -321,9 +330,7 @@ class InputSplitBase(InputSplit):
         raise NotImplementedError
 
     def close(self) -> None:
-        if getattr(self, "_native", None) is not None:
-            self._native.close()
-            self._native = None
+        self._stop_native_reader()
         if self._stream is not None:
             self._stream.close()
             self._stream = None

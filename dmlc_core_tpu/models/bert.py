@@ -278,8 +278,9 @@ class BERT:
         CHECK(tokens.shape[-1] <= self.param.max_len,
               f"sequence length {tokens.shape[-1]} exceeds max_len "
               f"{self.param.max_len}")
-        CHECK(int(np.max(tokens)) < self.param.vocab_size,
-              "token id out of vocab range")
+        for name, arr in (("token", tokens), ("label", labels)):
+            CHECK(0 <= int(np.min(arr)) and int(np.max(arr)) < self.param.vocab_size,
+                  f"{name} id out of vocab range [0, {self.param.vocab_size})")
         seq_ax = "seq" if self._has_seq else None
         sh = NamedSharding(self.mesh, P("data", seq_ax))
         t = jax.device_put(np.asarray(tokens, np.int32), sh)

@@ -111,10 +111,11 @@ def test_sharding_oracle_native_vs_python(monkeypatch):
             for part in range(nparts):
                 s = InputSplit.create(tmp.path, part, nparts, "text",
                                       threaded=False)
+                out.append(list(s))
+                # native reader starts lazily on the first read
                 assert (s._native is not None) == (
                     os.environ.get("DMLC_TPU_NATIVE_IO", "1") != "0"
                     and _native_io.native_io_available())
-                out.append(list(s))
                 s.close()
             return out
 

@@ -1,0 +1,411 @@
+"""Remote filesystem backends against in-process fake servers (no egress).
+
+Each fake implements the minimal REST surface its backend speaks (S3 XML,
+WebHDFS JSON, Azure blob XML, GCS JSON), backed by a shared dict — so the
+whole URI-driven stack (Stream.create → InputSplit sharding → RecordIO)
+is exercised over "remote" storage hermetically, mirroring how the
+reference left S3/HDFS untested in CI but we do better.
+"""
+
+import datetime
+import json
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_core_tpu.io.filesystem import FileSystem, URI
+from dmlc_core_tpu.io.input_split import InputSplit
+from dmlc_core_tpu.io.recordio import encode_records
+from dmlc_core_tpu.io.s3_filesys import sigv4_headers
+from dmlc_core_tpu.io.stream import Stream
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+
+class _FakeBase(BaseHTTPRequestHandler):
+    store: dict  # class attr: key "container/blob" -> bytes
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def _send(self, status, body=b"", headers=None):
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def _range(self, blob, header="Range"):
+        rng = self.headers.get(header)
+        if not rng:
+            return 200, blob
+        lo, _, hi = rng.split("=")[1].partition("-")
+        lo = int(lo)
+        hi = int(hi) if hi else len(blob) - 1
+        return 206, blob[lo:hi + 1]
+
+
+class _S3Fake(_FakeBase):
+    """GET/HEAD/PUT objects, ListObjectsV2, multipart upload."""
+
+    uploads: dict = {}
+
+    def do_HEAD(self):
+        key = self.path.lstrip("/").split("?")[0]
+        key = urllib.parse.unquote(key)
+        if key in self.store:
+            # HEAD: Content-Length advertises the blob size, no body follows
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(self.store[key])))
+            self.end_headers()
+        else:
+            self._send(404)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        if "list-type" in q:  # bucket listing: path is "/bucket"
+            bucket = key.split("/")[0]
+            prefix = q.get("prefix", "")
+            items = sorted(k for k in self.store
+                           if k.startswith(f"{bucket}/")
+                           and k[len(bucket) + 1:].startswith(prefix))
+            contents = "".join(
+                f"<Contents><Key>{k[len(bucket) + 1:]}</Key>"
+                f"<Size>{len(self.store[k])}</Size></Contents>"
+                for k in items)
+            xml = (f'<ListBucketResult xmlns="http://s3.amazonaws.com/doc/'
+                   f'2006-03-01/">{contents}</ListBucketResult>')
+            self._send(200, xml.encode())
+            return
+        if key in self.store:
+            status, body = self._range(self.store[key])
+            self._send(status, body)
+        else:
+            self._send(404)
+
+    def do_PUT(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        body = self._body()
+        if "partNumber" in q:
+            self.uploads.setdefault(q["uploadId"], {})[int(q["partNumber"])] = body
+            self._send(200, b"", {"ETag": f'"part{q["partNumber"]}"'})
+            return
+        self.store[key] = body
+        self._send(200)
+
+    def do_POST(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        if "uploads" in q:
+            uid = f"up{len(self.uploads)}"
+            self.uploads[uid] = {}
+            self._send(200, (f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                             f"</UploadId></InitiateMultipartUploadResult>").encode())
+            return
+        if "uploadId" in q:
+            self._body()
+            parts = self.uploads.pop(q["uploadId"])
+            self.store[key] = b"".join(parts[i] for i in sorted(parts))
+            self._send(200, b"<CompleteMultipartUploadResult/>")
+            return
+        self._send(400)
+
+
+class _HDFSFake(_FakeBase):
+    """WebHDFS: GETFILESTATUS, LISTSTATUS, OPEN, CREATE/APPEND w/ redirect."""
+
+    def _q(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        return (urllib.parse.unquote(parsed.path.replace("/webhdfs/v1", "", 1)),
+                dict(urllib.parse.parse_qsl(parsed.query)))
+
+    def do_GET(self):
+        path, q = self._q()
+        op = q.get("op", "").upper()
+        key = path.lstrip("/")
+        if op == "GETFILESTATUS":
+            if key in self.store:
+                st = {"type": "FILE", "length": len(self.store[key])}
+            elif any(k.startswith(key.rstrip("/") + "/") for k in self.store):
+                st = {"type": "DIRECTORY", "length": 0}
+            else:
+                self._send(404, b'{"RemoteException":{}}')
+                return
+            self._send(200, json.dumps({"FileStatus": st}).encode())
+        elif op == "LISTSTATUS":
+            prefix = key.rstrip("/") + "/" if key else ""
+            children = sorted({k[len(prefix):].split("/")[0]
+                               for k in self.store if k.startswith(prefix)})
+            sts = [{"pathSuffix": c, "type": "FILE",
+                    "length": len(self.store[prefix + c])}
+                   for c in children if (prefix + c) in self.store]
+            self._send(200, json.dumps(
+                {"FileStatuses": {"FileStatus": sts}}).encode())
+        elif op == "OPEN":
+            blob = self.store.get(key)
+            if blob is None:
+                self._send(404)
+                return
+            off = int(q.get("offset", 0))
+            length = int(q.get("length", len(blob) - off))
+            self._send(200, blob[off:off + length])
+        else:
+            self._send(400)
+
+    def do_PUT(self):
+        path, q = self._q()
+        if q.get("op", "").upper() == "CREATE":
+            if "redirected" not in q:
+                loc = (f"http://{self.headers['Host']}/webhdfs/v1{path}"
+                       f"?op=CREATE&redirected=1")
+                self._send(307, b"", {"Location": loc})
+                return
+            self.store[path.lstrip("/")] = self._body()
+            self._send(201)
+        else:
+            self._send(400)
+
+    def do_POST(self):
+        path, q = self._q()
+        if q.get("op", "").upper() == "APPEND":
+            if "redirected" not in q:
+                loc = (f"http://{self.headers['Host']}/webhdfs/v1{path}"
+                       f"?op=APPEND&redirected=1")
+                self._send(307, b"", {"Location": loc})
+                return
+            self.store[path.lstrip("/")] += self._body()
+            self._send(200)
+        else:
+            self._send(400)
+
+
+class _AzureFake(_FakeBase):
+    blocks: dict = {}
+
+    def do_HEAD(self):
+        key = urllib.parse.unquote(
+            urllib.parse.urlsplit(self.path).path.lstrip("/"))
+        if key in self.store:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(self.store[key])))
+            self.end_headers()
+        else:
+            self._send(404)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        if q.get("comp") == "list":
+            container = key.split("/")[0]
+            prefix = q.get("prefix", "")
+            blobs = "".join(
+                f"<Blob><Name>{k[len(container) + 1:]}</Name><Properties>"
+                f"<Content-Length>{len(self.store[k])}</Content-Length>"
+                f"</Properties></Blob>"
+                for k in sorted(self.store)
+                if k.startswith(f"{container}/")
+                and k[len(container) + 1:].startswith(prefix))
+            xml = (f"<EnumerationResults><Blobs>{blobs}</Blobs>"
+                   f"<NextMarker/></EnumerationResults>")
+            self._send(200, xml.encode())
+            return
+        if key in self.store:
+            status, body = self._range(self.store[key], "x-ms-range")
+            self._send(status, body)
+        else:
+            self._send(404)
+
+    def do_PUT(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        body = self._body()
+        if q.get("comp") == "block":
+            self.blocks.setdefault(key, {})[q["blockid"]] = body
+            self._send(201)
+        elif q.get("comp") == "blocklist":
+            import re
+            ids = re.findall(rb"<Latest>(.*?)</Latest>", body)
+            blocks = self.blocks.pop(key, {})
+            self.store[key] = b"".join(blocks[i.decode()] for i in ids)
+            self._send(201)
+        else:
+            self.store[key] = body
+            self._send(201)
+
+
+class _GCSFake(_FakeBase):
+    def do_GET(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        path = parsed.path
+        if path.startswith("/download/storage/v1/b/"):
+            _, _, rest = path.partition("/b/")
+            bucket, _, obj = rest.partition("/o/")
+            key = f"{bucket}/{urllib.parse.unquote(obj)}"
+            if key not in self.store:
+                self._send(404)
+                return
+            status, body = self._range(self.store[key])
+            self._send(status, body)
+            return
+        if path.startswith("/storage/v1/b/") and "/o/" in path:
+            _, _, rest = path.partition("/b/")
+            bucket, _, obj = rest.partition("/o/")
+            key = f"{bucket}/{urllib.parse.unquote(obj)}"
+            if key in self.store:
+                self._send(200, json.dumps(
+                    {"name": urllib.parse.unquote(obj),
+                     "size": str(len(self.store[key]))}).encode())
+            else:
+                self._send(404)
+            return
+        if path.startswith("/storage/v1/b/"):  # list
+            bucket = path.split("/b/")[1].split("/")[0]
+            prefix = q.get("prefix", "")
+            items = [{"name": k[len(bucket) + 1:],
+                      "size": str(len(self.store[k]))}
+                     for k in sorted(self.store)
+                     if k.startswith(f"{bucket}/")
+                     and k[len(bucket) + 1:].startswith(prefix)]
+            self._send(200, json.dumps({"items": items}).encode())
+            return
+        self._send(400)
+
+    def do_POST(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        if parsed.path.startswith("/upload/storage/v1/b/"):
+            bucket = parsed.path.split("/b/")[1].split("/")[0]
+            self.store[f"{bucket}/{q['name']}"] = self._body()
+            self._send(200, b"{}")
+            return
+        self._send(400)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def serve():
+    servers = []
+
+    def start(handler_cls, store):
+        handler = type("H", (handler_cls,), {"store": store})
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return f"http://127.0.0.1:{srv.server_address[1]}"
+
+    yield start
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _roundtrip(uri_of, monkeypatch):
+    """Shared backend exercise: write/read/list/split over the fake."""
+    # write + read back
+    payload = os.urandom(100_000)
+    with Stream.create(uri_of("dir/blob.bin"), "w") as s:
+        s.write(payload[:40_000])
+        s.write(payload[40_000:])
+    with Stream.create(uri_of("dir/blob.bin"), "r") as s:
+        assert s.read_all() == payload
+    # seek/ranged read
+    s = Stream.create_for_read(uri_of("dir/blob.bin"))
+    s.seek(99_990)
+    assert s.read(100) == payload[99_990:]
+    s.close()
+    # recordio shards + sharded InputSplit over the remote listing
+    all_recs = []
+    for k in range(3):
+        recs = [f"r{k}-{i}".encode() * (i % 5 + 1) for i in range(200)]
+        all_recs += recs
+        with Stream.create(uri_of(f"shards/part-{k}.rec"), "w") as s:
+            s.write(encode_records(recs))
+    seen = []
+    for part in range(4):
+        sp = InputSplit.create(uri_of("shards"), part, 4, "recordio",
+                               threaded=False)
+        seen += list(sp)
+        sp.close()
+    assert sorted(seen) == sorted(all_recs)
+
+
+def test_s3(serve, monkeypatch):
+    store = {}
+    endpoint = serve(_S3Fake, store)
+    monkeypatch.setenv("S3_ENDPOINT", endpoint)
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDEXAMPLE")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    _roundtrip(lambda p: f"s3://bkt/{p}", monkeypatch)
+
+
+def test_s3_multipart(serve, monkeypatch):
+    store = {}
+    endpoint = serve(_S3Fake, store)
+    monkeypatch.setenv("S3_ENDPOINT", endpoint)
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    big = os.urandom(20 << 20)  # > 2 parts at 8 MiB
+    with Stream.create("s3://bkt/big.bin", "w") as s:
+        s.write(big)
+    assert store["bkt/big.bin"] == big
+
+
+def test_hdfs(serve, monkeypatch):
+    store = {}
+    endpoint = serve(_HDFSFake, store)
+    monkeypatch.setenv("DMLC_HDFS_NAMENODE", endpoint)
+    _roundtrip(lambda p: f"hdfs:///{p}", monkeypatch)
+
+
+def test_azure(serve, monkeypatch):
+    store = {}
+    endpoint = serve(_AzureFake, store)
+    monkeypatch.setenv("AZURE_BLOB_ENDPOINT", endpoint)
+    _roundtrip(lambda p: f"azure://ctr/{p}", monkeypatch)
+
+
+def test_gcs(serve, monkeypatch):
+    store = {}
+    endpoint = serve(_GCSFake, store)
+    monkeypatch.setenv("GCS_ENDPOINT", endpoint)
+    _roundtrip(lambda p: f"gs://bkt/{p}", monkeypatch)
+
+
+def test_sigv4_known_vector():
+    """AWS SigV4 test vector (GET, us-east-1, service 'service')."""
+    now = datetime.datetime(2015, 8, 30, 12, 36, 0,
+                            tzinfo=datetime.timezone.utc)
+    hdrs = sigv4_headers(
+        "GET", "https://example.amazonaws.com/?Param1=value1&Param2=value2",
+        {}, b"",
+        access_key="AKIDEXAMPLE",
+        secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        region="us-east-1", service="service", now=now)
+    # the signature from the published aws-sig-v4-test-suite
+    # (get-vanilla-query-order-key-case) with these exact inputs
+    assert hdrs["x-amz-date"] == "20150830T123600Z"
+    assert "Credential=AKIDEXAMPLE/20150830/us-east-1/service/aws4_request" \
+        in hdrs["Authorization"]
+    assert hdrs["Authorization"].endswith(
+        "Signature=b97d918cfa904a5beff61c982a1b6f458b799221646efd99d3219ec94cdf2500")
