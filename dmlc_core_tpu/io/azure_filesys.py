@@ -20,7 +20,7 @@ from __future__ import annotations
 import os
 import urllib.parse
 import xml.etree.ElementTree as ET
-from typing import List
+from typing import List, Optional
 
 from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.io.filesystem import FS_REGISTRY, FileInfo, FileSystem, URI
@@ -111,17 +111,23 @@ class AzureFileSystem(FileSystem):
         except HttpError as e:
             if e.status != 404:
                 raise
-        if self._list(container, blob.rstrip("/") + "/"):
+        if self._list(container, blob.rstrip("/") + "/", max_results=1,
+                      max_pages=1):
             return FileInfo(path=f"azure://{container}/{blob}", size=0,
                             type="directory")
         raise FileNotFoundError(f"azure://{container}/{blob}")
 
-    def _list(self, container: str, prefix: str) -> List[FileInfo]:
+    def _list(self, container: str, prefix: str,
+              max_results: Optional[int] = None,
+              max_pages: Optional[int] = None) -> List[FileInfo]:
         out: List[FileInfo] = []
         marker = ""
+        pages = 0
         while True:
             q = (f"restype=container&comp=list&delimiter=%2F"
                  f"&prefix={urllib.parse.quote(prefix)}")
+            if max_results:
+                q += f"&maxresults={max_results}"
             if marker:
                 q += f"&marker={urllib.parse.quote(marker)}"
             _, _, body = http_request("GET", self._url(container, query=q))
@@ -137,7 +143,8 @@ class AzureFileSystem(FileSystem):
                     out.append(FileInfo(path=f"azure://{container}/{name}",
                                         size=0, type="directory"))
             marker = root.findtext("NextMarker") or ""
-            if not marker:
+            pages += 1
+            if not marker or (max_pages is not None and pages >= max_pages):
                 return out
 
     def list_directory(self, uri: URI) -> List[FileInfo]:

@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 import os
 import urllib.parse
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.io.filesystem import FS_REGISTRY, FileInfo, FileSystem, URI
@@ -98,16 +98,19 @@ class GCSFileSystem(FileSystem):
         except HttpError as e:
             if e.status != 404:
                 raise
-        files, prefixes = self._list(bucket, obj.rstrip("/") + "/", max_results=1)
+        files, prefixes = self._list(bucket, obj.rstrip("/") + "/",
+                                     max_results=1, max_pages=1)
         if files or prefixes:
             return FileInfo(path=f"gs://{bucket}/{obj}", size=0, type="directory")
         raise FileNotFoundError(f"gs://{bucket}/{obj}")
 
-    def _list(self, bucket: str, prefix: str, max_results: int = 1000
+    def _list(self, bucket: str, prefix: str, max_results: int = 1000,
+              max_pages: Optional[int] = None
               ) -> Tuple[List[FileInfo], List[str]]:
         out: List[FileInfo] = []
         prefixes: List[str] = []
         token = ""
+        pages = 0
         while True:
             url = (f"{self._endpoint}/storage/v1/b/{bucket}/o"
                    f"?prefix={urllib.parse.quote(prefix)}&delimiter=%2F"
@@ -121,7 +124,8 @@ class GCSFileSystem(FileSystem):
                                     size=int(item.get("size", 0)), type="file"))
             prefixes.extend(data.get("prefixes", []))
             token = data.get("nextPageToken", "")
-            if not token:
+            pages += 1
+            if not token or (max_pages is not None and pages >= max_pages):
                 return out, prefixes
 
     def list_directory(self, uri: URI) -> List[FileInfo]:

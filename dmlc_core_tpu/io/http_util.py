@@ -175,6 +175,19 @@ class BufferedWriteStream(Stream):
         self._finish(b"".join(self._chunks))
         self._chunks = []
 
+    def abort(self) -> None:
+        """Discard buffered data without committing the object."""
+        self._closed = True
+        self._chunks = []
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # an exception inside the `with` block must NOT publish a
+        # truncated object — discard instead of committing partial parts
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
+
     # -- backend hooks ---------------------------------------------------
     def _flush_part(self, part: bytes) -> None:
         raise NotImplementedError

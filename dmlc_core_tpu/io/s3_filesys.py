@@ -220,17 +220,22 @@ class S3FileSystem(FileSystem):
             if e.status != 404:
                 raise
         # not an object → directory if any key or sub-prefix lives under it
-        files, prefixes = self._list(bucket, key.rstrip("/") + "/", max_keys=1)
+        files, prefixes = self._list(bucket, key.rstrip("/") + "/", max_keys=1,
+                                     max_pages=1)
         if files or prefixes:
             return FileInfo(path=f"s3://{bucket}/{key}", size=0, type="directory")
         raise FileNotFoundError(f"s3://{bucket}/{key}")
 
-    def _list(self, bucket: str, prefix: str, max_keys: int = 1000
+    def _list(self, bucket: str, prefix: str, max_keys: int = 1000,
+              max_pages: Optional[int] = None
               ) -> Tuple[List[Tuple[str, int]], List[str]]:
-        """ListObjectsV2 with paging → ([(key, size)], [common prefixes])."""
+        """ListObjectsV2 with paging → ([(key, size)], [common prefixes]).
+
+        ``max_pages`` caps the round trips (existence probes need one)."""
         out: List[Tuple[str, int]] = []
         prefixes: List[str] = []
         token = ""
+        pages = 0
         while True:
             query = ("list-type=2&delimiter=%2F"
                      f"&prefix={urllib.parse.quote(prefix)}&max-keys={max_keys}")
@@ -250,7 +255,8 @@ class S3FileSystem(FileSystem):
                 if p:
                     prefixes.append(p)
             token = root.findtext(f"{ns}NextContinuationToken") or ""
-            if not token:
+            pages += 1
+            if not token or (max_pages is not None and pages >= max_pages):
                 return out, prefixes
 
     def list_directory(self, uri: URI) -> List[FileInfo]:

@@ -65,10 +65,18 @@ class _Logistic:
         return jax.nn.sigmoid(pred)
 
     @staticmethod
-    def metric(pred, y):  # logloss
+    def row_loss(pred, y):  # per-row logloss (mean of these = the metric)
         p = jax.nn.sigmoid(pred)
         eps = 1e-7
-        return -jnp.mean(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+        return -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+
+    @staticmethod
+    def metric(pred, y):  # logloss
+        return jnp.mean(_Logistic.row_loss(pred, y))
+
+    @staticmethod
+    def finalize_mean_loss(m: float) -> float:
+        return m
 
 
 @OBJECTIVES.register("reg:squarederror")
@@ -82,8 +90,68 @@ class _SquaredError:
         return pred
 
     @staticmethod
-    def metric(pred, y):  # rmse
-        return jnp.sqrt(jnp.mean((pred - y) ** 2))
+    def row_loss(pred, y):  # per-row squared error
+        return (pred - y) ** 2
+
+    @staticmethod
+    def metric(pred, y):  # rmse = sqrt of the mean row loss
+        return jnp.sqrt(jnp.mean(_SquaredError.row_loss(pred, y)))
+
+    @staticmethod
+    def finalize_mean_loss(m: float) -> float:
+        return float(np.sqrt(m))
+
+
+def _make_best_split(B: int, lam: float, gamma: float, mcw: float):
+    """Greedy per-node split chooser over a gradient histogram.
+
+    hist [2,N,F,B] → (feat [N], thr [N]); degenerate split (feat 0,
+    thr B-1 → everyone left) when gain ≤ gamma.  Shared by the in-core
+    shard_map round and the external-memory page loop.
+    """
+
+    def best_split(hist):
+        g = hist[0]
+        h = hist[1]
+        gl = jnp.cumsum(g, axis=-1)[..., :-1]        # [N,F,B-1] left: bin ≤ b
+        hl = jnp.cumsum(h, axis=-1)[..., :-1]
+        gt = jnp.sum(g, axis=-1, keepdims=True)      # [N,F,1]
+        ht = jnp.sum(h, axis=-1, keepdims=True)
+        gr = gt - gl
+        hr = ht - hl
+        gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam) - gt**2 / (ht + lam))
+        ok = (hl >= mcw) & (hr >= mcw)
+        gain = jnp.where(ok, gain, -jnp.inf)
+        flat = gain.reshape(gain.shape[0], -1)       # [N, F*(B-1)]
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        feat = (best // (B - 1)).astype(jnp.int32)
+        thr = (best % (B - 1)).astype(jnp.int32)
+        split_ok = 0.5 * best_gain > gamma
+        feat = jnp.where(split_ok, feat, 0)
+        thr = jnp.where(split_ok, thr, B - 1)        # bins ≤ B-1 → all left
+        return feat, thr
+
+    return best_split
+
+
+# -- external-memory page kernels (jitted once per page shape) --------------
+
+@jax.jit
+def _advance_node(bins, node, feat, thr):
+    """Route rows one level down the tree; padding rows (node<0) stay -1."""
+    valid = node >= 0
+    safe = jnp.where(valid, node, 0)
+    row_bin = jnp.take_along_axis(bins, feat[safe][:, None], axis=1)[:, 0]
+    nxt = 2 * safe + (row_bin.astype(jnp.int32) > thr[safe]).astype(jnp.int32)
+    return jnp.where(valid, nxt, -1)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _leaf_sums(node, g, h, n_leaf):
+    safe = jnp.where(node >= 0, node, 0)  # padding rows carry g=h=0
+    return (jax.ops.segment_sum(g, safe, num_segments=n_leaf),
+            jax.ops.segment_sum(h, safe, num_segments=n_leaf))
 
 
 class HistGBTParam(Parameter):
@@ -201,6 +269,140 @@ class HistGBT:
         return None
 
     # ------------------------------------------------------------------
+    # external-memory training (BASELINE config 3)
+    # ------------------------------------------------------------------
+    def fit_external(
+        self,
+        row_iter,
+        num_col: Optional[int] = None,
+        eval_every: int = 0,
+        sketch_pages: int = 32,
+        cuts: Optional[jax.Array] = None,
+    ) -> "HistGBT":
+        """Out-of-core boosting over a :class:`RowBlockIter` (sparse CSR
+        pages from a Parser/DiskRowIter — the Criteo-scale path).
+
+        Never materializes the dataset: pass 1 streams pages through a
+        bounded-memory :class:`SketchAccumulator` (the fixed-size sketch
+        "allreduce" replacing the reference world's variable-size rabit
+        sketch merge); pass 2 bins each page to uint8 (4× smaller than
+        raw f32, the only per-row state kept); each round then rescans
+        binned pages level-by-level, accumulating node histograms on
+        device and allreducing across workers.  Missing CSR entries bin
+        as 0.0 (XGBoost's dense-hist convention for Criteo-style data).
+
+        Trees produced are the same arrays as :meth:`fit`, so
+        :meth:`predict` and checkpointing work unchanged.
+        """
+        from dmlc_core_tpu.ops.quantile import SketchAccumulator
+        from dmlc_core_tpu.parallel import collectives as coll
+
+        p = self.param
+        B = p.n_bins
+        depth = p.max_depth
+        n_leaf = 1 << depth
+        half = max(n_leaf >> 1, 1)
+        best_split = _make_best_split(B, p.reg_lambda, p.gamma,
+                                      p.min_child_weight)
+
+        # -- pass 1: streaming sketch --------------------------------------
+        F = max(num_col or 0, row_iter.num_col)
+        if coll.world_size() > 1:
+            # sparse shards can disagree on the max feature index; the
+            # sketch allgather and histogram allreduce need one global F
+            # (reference world: rabit allreduce-max of num_col)
+            F = int(coll.allreduce(np.asarray([F], np.int64), op="max")[0])
+        CHECK(F > 0, "fit_external: empty input")
+        if cuts is not None:
+            self.cuts = cuts
+        else:
+            sketch: Optional[SketchAccumulator] = None
+            for block in row_iter:
+                X = block.to_dense(F)
+                if sketch is None:
+                    sketch = SketchAccumulator(F, n_summary=max(8 * B, 64),
+                                               buffer_pages=sketch_pages)
+                sketch.add(X, block.weight)
+            CHECK(sketch is not None, "fit_external: empty input")
+            self.cuts = sketch.finalize(B, allgather_fn=self._maybe_allgather())
+
+        # -- pass 2: bin pages (uint8) -------------------------------------
+        pages: List[Dict[str, np.ndarray]] = []
+        for block in row_iter:
+            X = block.to_dense(F)
+            bins = np.asarray(apply_bins(jnp.asarray(X), self.cuts))
+            w = (np.asarray(block.weight, np.float32)
+                 if block.weight is not None else np.ones(len(X), np.float32))
+            pages.append({
+                "bins": bins,
+                "y": np.asarray(block.label, np.float32),
+                "w": w,
+                "preds": np.full(len(X), p.base_score, np.float32),
+            })
+
+        distributed = coll.world_size() > 1
+        obj = self._obj
+        t0 = get_time()
+        for r in range(p.n_trees):
+            # grad/hess per page for this round
+            for pg in pages:
+                g, h = obj.grad_hess(jnp.asarray(pg["preds"]),
+                                     jnp.asarray(pg["y"]))
+                pg["g"] = np.asarray(g) * pg["w"]
+                pg["h"] = np.asarray(h) * pg["w"]
+                pg["node"] = np.zeros(len(pg["y"]), np.int32)
+            feats, thrs = [], []
+            for level in range(depth):
+                n_nodes = 1 << level
+                hist = None
+                for pg in pages:
+                    ph = build_histogram(
+                        jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
+                        jnp.asarray(pg["g"]), jnp.asarray(pg["h"]),
+                        n_nodes, B, p.hist_method)
+                    hist = ph if hist is None else hist + ph
+                hist_np = np.asarray(hist)
+                if distributed:
+                    hist_np = coll.allreduce(hist_np)  # cross-worker sync
+                feat, thr = best_split(jnp.asarray(hist_np))
+                feats.append(np.pad(np.asarray(feat), (0, half - n_nodes)))
+                thrs.append(np.pad(np.asarray(thr), (0, half - n_nodes)))
+                for pg in pages:
+                    pg["node"] = np.asarray(_advance_node(
+                        jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
+                        jnp.asarray(feat), jnp.asarray(thr)))
+            gsum = np.zeros(n_leaf, np.float32)
+            hsum = np.zeros(n_leaf, np.float32)
+            for pg in pages:
+                gs, hs = _leaf_sums(jnp.asarray(pg["node"]),
+                                    jnp.asarray(pg["g"]),
+                                    jnp.asarray(pg["h"]), n_leaf)
+                gsum += np.asarray(gs)
+                hsum += np.asarray(hs)
+            if distributed:
+                gsum = coll.allreduce(gsum)
+                hsum = coll.allreduce(hsum)
+            leaf = (-gsum / (hsum + p.reg_lambda) * p.learning_rate
+                    ).astype(np.float32)
+            for pg in pages:
+                pg["preds"] = pg["preds"] + leaf[pg["node"]]
+            self.trees.append({
+                "feat": np.stack(feats), "thr": np.stack(thrs), "leaf": leaf,
+            })
+            if eval_every and (r + 1) % eval_every == 0:
+                # mean of per-row losses across ALL pages, then the
+                # objective's finalizer (sqrt for rmse) — a page-wise mean
+                # of metrics would be wrong for non-additive metrics
+                num = sum(float(np.sum(np.asarray(obj.row_loss(
+                    jnp.asarray(pg["preds"]), jnp.asarray(pg["y"])))))
+                    for pg in pages)
+                den = sum(len(pg["y"]) for pg in pages)
+                loss = obj.finalize_mean_loss(num / max(den, 1))
+                LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
+        self.last_fit_seconds = get_time() - t0
+        return self
+
+    # ------------------------------------------------------------------
     def _build_round_fn(self, n_features: int):
         p = self.param
         depth = p.max_depth
@@ -214,29 +416,7 @@ class HistGBT:
         n_leaf = 1 << depth
         half = max(n_leaf >> 1, 1)
 
-        def best_split(hist):
-            """hist [2,N,F,B] → (feat [N], thr [N]); degenerate split
-            (feat 0, thr B-1 → everyone left) when gain ≤ gamma."""
-            g = hist[0]
-            h = hist[1]
-            gl = jnp.cumsum(g, axis=-1)[..., :-1]        # [N,F,B-1] left: bin ≤ b
-            hl = jnp.cumsum(h, axis=-1)[..., :-1]
-            gt = jnp.sum(g, axis=-1, keepdims=True)      # [N,F,1]
-            ht = jnp.sum(h, axis=-1, keepdims=True)
-            gr = gt - gl
-            hr = ht - hl
-            gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam) - gt**2 / (ht + lam))
-            ok = (hl >= mcw) & (hr >= mcw)
-            gain = jnp.where(ok, gain, -jnp.inf)
-            flat = gain.reshape(gain.shape[0], -1)       # [N, F*(B-1)]
-            best = jnp.argmax(flat, axis=1)
-            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-            feat = (best // (B - 1)).astype(jnp.int32)
-            thr = (best % (B - 1)).astype(jnp.int32)
-            split_ok = 0.5 * best_gain > gamma
-            feat = jnp.where(split_ok, feat, 0)
-            thr = jnp.where(split_ok, thr, B - 1)        # bins ≤ B-1 → all left
-            return feat, thr
+        best_split = _make_best_split(B, lam, gamma, mcw)
 
         def round_body(bins_l, y_l, w_l, preds_l):
             g, h = obj.grad_hess(preds_l, y_l)

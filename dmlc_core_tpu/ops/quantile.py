@@ -30,7 +30,8 @@ import numpy as np
 
 from dmlc_core_tpu.base.logging import CHECK
 
-__all__ = ["local_summary", "merge_summaries", "compute_cuts", "apply_bins"]
+__all__ = ["local_summary", "merge_summaries", "compute_cuts", "apply_bins",
+           "SketchAccumulator"]
 
 
 @partial(jax.jit, static_argnums=(2,))
@@ -92,6 +93,102 @@ def compute_cuts(
     else:
         gathered = summary[None]
     return merge_summaries(gathered, n_bins)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _weighted_collapse(stack: jax.Array, wts: jax.Array, n_out: int) -> jax.Array:
+    """Merge ``[K, F, S]`` summaries with per-summary weights ``[K]`` into
+    one ``[F, n_out]`` summary.
+
+    Each summary point carries ``w_k / S`` mass; the merged multiset is
+    re-quantiled on an even grid — the fixed-shape equivalent of the
+    reference world's variable-size sketch merge (``GK/WQSummary.Merge``).
+    """
+    K, F, S = stack.shape
+    pts = jnp.transpose(stack, (1, 0, 2)).reshape(F, K * S)            # [F, K·S]
+    w = jnp.broadcast_to((wts / S)[:, None], (K, S)).reshape(K * S)    # [K·S]
+    order = jnp.argsort(pts, axis=1)
+    xs = jnp.take_along_axis(pts, order, axis=1)
+    ws = jnp.broadcast_to(w[None, :], (F, K * S))
+    ws = jnp.take_along_axis(ws, order, axis=1)
+    cw = jnp.cumsum(ws, axis=1)
+    total = cw[:, -1:]
+    probs = (cw - 0.5 * ws) / total                                    # midpoint rule
+    qs = jnp.linspace(0.0, 1.0, n_out)
+    return jax.vmap(lambda xf, pf: jnp.interp(qs, pf, xf))(xs, probs)  # [F, n_out]
+
+
+class SketchAccumulator:
+    """Streaming quantile sketch with bounded memory (BASELINE config 3).
+
+    The out-of-core path: pages of rows arrive one at a time (DiskRowIter /
+    Parser over a 1TB input); each page contributes a fixed-size weighted
+    summary, and the buffer of page summaries hierarchically collapses so
+    host memory stays ``O(buffer_pages · F · n_summary)`` no matter how
+    many rows stream through.  ``finalize`` optionally allreduces (as an
+    allgather+merge) across workers — the TPU-native replacement for the
+    reference world's variable-size quantile-sketch allreduce
+    (``tracker.py``-coordinated rabit ``SerializeReducer``).
+    """
+
+    def __init__(self, n_features: int, n_summary: int = 2048,
+                 buffer_pages: int = 32):
+        CHECK(buffer_pages >= 2, "need at least 2 buffered summaries")
+        self._F = n_features
+        self._S = n_summary
+        self._cap = buffer_pages
+        self._summaries: list = []   # each [F, S] np.float32
+        self._weights: list = []     # total row weight represented
+
+    def add(self, x: np.ndarray, weight: Optional[np.ndarray] = None) -> None:
+        """Absorb a page of rows ``[n, F]`` (``weight``: [n] or None)."""
+        x = np.asarray(x, np.float32)
+        CHECK(x.shape[1] == self._F, "feature-count mismatch")
+        if x.shape[0] == 0:
+            return
+        s = local_summary(jnp.asarray(x),
+                          None if weight is None else jnp.asarray(weight),
+                          self._S)
+        self._summaries.append(np.asarray(s))
+        self._weights.append(
+            float(x.shape[0] if weight is None else np.sum(weight)))
+        if len(self._summaries) >= self._cap:
+            self._collapse()
+
+    def _collapse(self) -> None:
+        stack = jnp.asarray(np.stack(self._summaries))
+        wts = jnp.asarray(np.asarray(self._weights, np.float32))
+        merged = _weighted_collapse(stack, wts, self._S)
+        self._summaries = [np.asarray(merged)]
+        self._weights = [float(np.sum(self._weights))]
+
+    def summary(self) -> tuple:
+        """Current ``([F, S] summary, total_weight)`` — the fixed-size
+        message exchanged between workers."""
+        CHECK(self._summaries, "no data added")
+        if len(self._summaries) > 1:
+            self._collapse()
+        return self._summaries[0], self._weights[0]
+
+    def finalize(self, n_bins: int, allgather_fn=None) -> jax.Array:
+        """Merged cut points ``[F, n_bins-1]``.
+
+        ``allgather_fn(arr) -> [W, ...]`` gathers across workers (e.g.
+        ``collectives.allgather``); every worker computes identical cuts
+        deterministically from the gathered summaries — no broadcast step.
+        """
+        local, wt = self.summary()
+        if allgather_fn is not None:
+            # allgather stacks rank contributions on a new leading axis
+            gathered = np.asarray(allgather_fn(local))            # [W, F, S]
+            wts = np.asarray(
+                allgather_fn(np.asarray(wt, np.float32))).reshape(-1)  # [W]
+        else:
+            gathered = local[None]
+            wts = np.asarray([wt], np.float32)
+        merged = _weighted_collapse(
+            jnp.asarray(gathered), jnp.asarray(wts), self._S)     # [F, S]
+        return merge_summaries(merged[None], n_bins)
 
 
 @jax.jit

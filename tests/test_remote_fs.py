@@ -392,6 +392,20 @@ def test_gcs(serve, monkeypatch):
     _roundtrip(lambda p: f"gs://bkt/{p}", monkeypatch)
 
 
+def test_write_aborts_on_exception(serve, monkeypatch):
+    """An exception inside `with Stream.create(..., 'w')` must not publish
+    a truncated object."""
+    store = {}
+    endpoint = serve(_S3Fake, store)
+    monkeypatch.setenv("S3_ENDPOINT", endpoint)
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    with pytest.raises(RuntimeError):
+        with Stream.create("s3://bkt/partial.bin", "w") as s:
+            s.write(b"x" * 1000)
+            raise RuntimeError("consumer failure mid-write")
+    assert "bkt/partial.bin" not in store
+
+
 def test_sigv4_known_vector():
     """AWS SigV4 test vector (GET, us-east-1, service 'service')."""
     now = datetime.datetime(2015, 8, 30, 12, 36, 0,
