@@ -418,6 +418,15 @@ class HistGBT:
 
         best_split = _make_best_split(B, lam, gamma, mcw)
 
+        def table_select(table, node, n_entries):
+            """Gather-free ``table[node]`` for a tiny per-node table: a
+            compare-and-sum over the (≤2^depth) entries.  TPU gathers over
+            row-indexed tables serialize badly; a [n, N] broadcast-compare
+            fuses into one VPU loop."""
+            n_iota = jnp.arange(n_entries, dtype=jnp.int32)[None, :]
+            oh = (node[:, None] == n_iota)
+            return jnp.sum(jnp.where(oh, table[None, :], 0), axis=1)
+
         def round_body(bins_l, y_l, w_l, preds_l):
             g, h = obj.grad_hess(preds_l, y_l)
             g = g * w_l
@@ -433,14 +442,26 @@ class HistGBT:
                 # pad per-level arrays to a common width for stacking
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
-                row_bin = jnp.take_along_axis(bins_l, feat[node][:, None], axis=1)[:, 0]
-                node = 2 * node + (row_bin > thr[node]).astype(jnp.int32)
-            gsum = jax.lax.psum(
-                jax.ops.segment_sum(g, node, num_segments=n_leaf), "data")
-            hsum = jax.lax.psum(
-                jax.ops.segment_sum(h, node, num_segments=n_leaf), "data")
+                # descend one level, gather-free: select each row's split
+                # feature value by compare-and-sum over the F columns
+                feat_sel = table_select(feat, node, n_nodes)          # [n]
+                thr_sel = table_select(thr, node, n_nodes)            # [n]
+                f_iota = jnp.arange(bins_l.shape[1], dtype=jnp.int32)[None, :]
+                row_bin = jnp.sum(
+                    jnp.where(feat_sel[:, None] == f_iota,
+                              bins_l.astype(jnp.int32), 0), axis=1)   # [n]
+                node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
+            # leaf grad/hess sums via the MXU histogram engine (a 1-feature
+            # histogram IS the per-node segment sum; segment_sum scatters
+            # serialize on TPU)
+            ones_col = jnp.zeros((bins_l.shape[0], 1), jnp.uint8)
+            lsum = build_histogram(ones_col, node, g, h, n_leaf, 8,
+                                   "matmul" if method in ("matmul", "pallas")
+                                   else method)
+            lsum = jax.lax.psum(jnp.sum(lsum[:, :, 0, :], axis=-1), "data")
+            gsum, hsum = lsum[0], lsum[1]
             leaf = -gsum / (hsum + lam) * eta
-            preds_new = preds_l + leaf[node]
+            preds_new = preds_l + table_select(leaf, node, n_leaf)
             tree = {
                 "feat": jnp.stack(feats),                # [depth, half]
                 "thr": jnp.stack(thrs),
