@@ -1,3 +1,5 @@
 """Base layer (L0–L1): logging/CHECK/Error, timer, env, registry, parameter,
-config.  Reference: include/dmlc/{logging,timer,parameter,registry,config}.h
-(see SURVEY.md §2a)."""
+config, thread-local store.  Reference: include/dmlc/{logging,timer,parameter,
+registry,config,thread_local}.h (see SURVEY.md §2a)."""
+
+from dmlc_core_tpu.base.thread_local import ThreadLocalStore  # noqa: F401

@@ -1,0 +1,54 @@
+"""Per-thread singleton store.
+
+Reference parity: ``include/dmlc/thread_local.h :: ThreadLocalStore<T>``
+(SURVEY.md §2a) — lazily constructs one instance of a type per thread and
+keeps a registry so instances can be enumerated/cleared (the reference
+uses this for per-thread scratch buffers and error strings).
+``threading.local`` alone loses the registry, so this keeps one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Generic, List, Tuple, TypeVar
+
+__all__ = ["ThreadLocalStore"]
+
+T = TypeVar("T")
+
+
+class ThreadLocalStore(Generic[T]):
+    """``store.get()`` → this thread's lazily-created instance.
+
+    >>> store = ThreadLocalStore(list)
+    >>> store.get() is store.get()        # same object within a thread
+    True
+    """
+
+    def __init__(self, factory: Callable[[], T]):
+        self._factory = factory
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._registry: Dict[int, Tuple[str, T]] = {}
+
+    def get(self) -> T:
+        try:
+            return self._local.value
+        except AttributeError:
+            value = self._factory()
+            self._local.value = value
+            th = threading.current_thread()
+            with self._lock:
+                self._registry[th.ident or id(th)] = (th.name, value)
+            return value
+
+    def instances(self) -> List[Tuple[str, T]]:
+        """(thread name, instance) for every thread that called get()."""
+        with self._lock:
+            return list(self._registry.values())
+
+    def clear(self) -> None:
+        """Drop the registry (existing threads re-create on next get())."""
+        with self._lock:
+            self._registry.clear()
+        self._local = threading.local()

@@ -20,6 +20,7 @@ from dmlc_core_tpu.io.filesystem import (  # noqa: F401
 )
 from dmlc_core_tpu.io.threaded_iter import ThreadedIter  # noqa: F401
 from dmlc_core_tpu.io.concurrency import ConcurrentBlockingQueue  # noqa: F401
+from dmlc_core_tpu.io.thread_group import ThreadGroup, ShutdownEvent  # noqa: F401
 from dmlc_core_tpu.io.recordio import (  # noqa: F401
     RecordIOWriter,
     RecordIOReader,

@@ -168,7 +168,8 @@ class HistGBTParam(Parameter):
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror"])
     base_score = field(float, default=0.0, description="initial raw margin")
-    hist_method = field(str, default="auto", enum=["auto", "segment", "matmul"],
+    hist_method = field(str, default="auto",
+                        enum=["auto", "segment", "matmul", "pallas"],
                         description="histogram engine (ops.histogram)")
 
 
@@ -451,14 +452,21 @@ class HistGBT:
                     jnp.where(feat_sel[:, None] == f_iota,
                               bins_l.astype(jnp.int32), 0), axis=1)   # [n]
                 node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
-            # leaf grad/hess sums via the MXU histogram engine (a 1-feature
-            # histogram IS the per-node segment sum; segment_sum scatters
-            # serialize on TPU)
-            ones_col = jnp.zeros((bins_l.shape[0], 1), jnp.uint8)
-            lsum = build_histogram(ones_col, node, g, h, n_leaf, 8,
-                                   "matmul" if method in ("matmul", "pallas")
-                                   else method)
-            lsum = jax.lax.psum(jnp.sum(lsum[:, :, 0, :], axis=-1), "data")
+            # leaf grad/hess sums as ONE exact-f32 one-hot matmul: [2, n]
+            # · [n, n_leaf] with HIGHEST precision keeps leaf weights
+            # bit-comparable to the segment_sum/CPU path (bf16 here would
+            # round every g/h to 8 mantissa bits before accumulating);
+            # segment_sum scatters serialize on TPU, so MXU still wins
+            leaf_oh = (node[:, None]
+                       == jnp.arange(n_leaf, dtype=jnp.int32)[None, :]
+                       ).astype(jnp.float32)                     # [n, n_leaf]
+            lsum = jax.lax.dot_general(
+                jnp.stack([g, h]), leaf_oh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )                                                     # [2, n_leaf]
+            lsum = jax.lax.psum(lsum, "data")
             gsum, hsum = lsum[0], lsum[1]
             leaf = -gsum / (hsum + lam) * eta
             preds_new = preds_l + table_select(leaf, node, n_leaf)

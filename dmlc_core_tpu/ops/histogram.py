@@ -53,11 +53,13 @@ def histogram_methods() -> list[str]:
 
 
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
-    """The pallas kernel needs lane-aligned one-hot rows and a VMEM-resident
-    accumulator (one-hot scratch ~7MB at HIGGS shapes + [2N, F·B] f32)."""
+    """The pallas kernel needs every per-feature one-hot slice
+    ``oh_ref[:, f·B:(f+1)·B]`` lane-aligned — i.e. ``n_bins % 128 == 0``,
+    not merely F·B — and a VMEM-resident accumulator (one-hot scratch
+    ~7MB at HIGGS shapes + [2N, F·B] f32)."""
     fb = n_features * n_bins
     vmem = 512 * fb * 2 + 2 * n_nodes * fb * 4
-    return fb % 128 == 0 and vmem <= 12 << 20
+    return n_bins % 128 == 0 and vmem <= 12 << 20
 
 
 def build_histogram(

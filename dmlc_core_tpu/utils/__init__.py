@@ -1,3 +1,10 @@
 """Shared runtime utilities (platform control, profiling)."""
 
 from dmlc_core_tpu.utils.platform import force_cpu_devices  # noqa: F401
+from dmlc_core_tpu.utils.profiler import (  # noqa: F401
+    Tracer,
+    annotate,
+    device_trace,
+    global_tracer,
+    step_annotation,
+)

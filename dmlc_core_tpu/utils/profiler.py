@@ -1,0 +1,171 @@
+"""Tracing & profiling — a strict superset of the reference's timing story.
+
+The reference's only primitive is ``include/dmlc/timer.h :: GetTime()``
+(SURVEY.md §5: "tracing/profiling: essentially none").  The TPU substrate
+owes more: step time vs infeed stall is THE number that decides whether
+the host pipeline (ThreadedIter → device_put) keeps the chip busy.  This
+module provides
+
+* :func:`device_trace` — context manager around ``jax.profiler.trace``:
+  captures an XLA/TensorBoard profile (HLO timelines, TPU utilization)
+  into a logdir;
+* :func:`annotate` / :func:`step_annotation` — named regions that show up
+  inside the device trace (thin wrappers over jax.profiler annotations,
+  no-ops if unavailable);
+* :class:`Tracer` — a dependency-free host-side event tracer writing
+  Chrome ``chrome://tracing`` / Perfetto JSON, so host pipeline phases
+  (read, parse, device_put, step) can be eyeballed against each other
+  without TensorBoard.
+
+All host events go through ``base.timer.get_time`` so Tracer timestamps
+line up with the rest of the framework's timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from dmlc_core_tpu.base.timer import get_time
+
+__all__ = ["device_trace", "annotate", "step_annotation", "Tracer",
+           "global_tracer"]
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """Capture a JAX/XLA device profile into ``logdir``.
+
+    View with TensorBoard's profile plugin.  Degrades to a no-op if the
+    profiler cannot start (e.g. another trace is active).
+    """
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:  # noqa: BLE001 — profiling must never break training
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region visible in the device trace (TraceAnnotation)."""
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+@contextlib.contextmanager
+def step_annotation(step: int, name: str = "train") -> Iterator[None]:
+    """Step marker so the profile viewer groups per-step activity."""
+    try:
+        import jax
+
+        ctx = jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:  # noqa: BLE001
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+class Tracer:
+    """Host-side event tracer → Chrome/Perfetto trace JSON.
+
+    >>> tr = Tracer()
+    >>> with tr.scope("parse"):
+    ...     ...
+    >>> tr.counter("queue_depth", 3)
+    >>> tr.save("/tmp/trace.json")   # open in chrome://tracing / Perfetto
+
+    Thread-safe; events carry real thread ids so producer/consumer
+    overlap (the ThreadedIter pipeline) is visible on separate rows.
+    """
+
+    def __init__(self) -> None:
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._t0 = get_time()
+
+    def _us(self) -> float:
+        return (get_time() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def scope(self, name: str, **args: Any) -> Iterator[None]:
+        """A complete ("X") duration event on the calling thread's row."""
+        start = self._us()
+        try:
+            yield
+        finally:
+            end = self._us()
+            with self._lock:
+                self._events.append({
+                    "name": name, "ph": "X", "ts": start,
+                    "dur": end - start, "pid": os.getpid(),
+                    "tid": threading.get_ident(),
+                    "args": args or {},
+                })
+
+    def instant(self, name: str, **args: Any) -> None:
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "i", "ts": self._us(), "s": "t",
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "args": args or {},
+            })
+
+    def counter(self, name: str, value: float, series: str = "value") -> None:
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "C", "ts": self._us(),
+                "pid": os.getpid(), "args": {series: value},
+            })
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def save(self, path: str) -> str:
+        with self._lock:
+            payload = {"traceEvents": list(self._events),
+                       "displayTimeUnit": "ms"}
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+
+_global: Optional[Tracer] = None
+_global_lock = threading.Lock()
+
+
+def global_tracer() -> Tracer:
+    """Process-wide Tracer (created on first use)."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = Tracer()
+        return _global
