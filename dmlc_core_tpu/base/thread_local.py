@@ -4,13 +4,17 @@ Reference parity: ``include/dmlc/thread_local.h :: ThreadLocalStore<T>``
 (SURVEY.md §2a) — lazily constructs one instance of a type per thread and
 keeps a registry so instances can be enumerated/cleared (the reference
 uses this for per-thread scratch buffers and error strings).
-``threading.local`` alone loses the registry, so this keeps one.
+``threading.local`` alone loses the registry, so this keeps one — keyed
+weakly by the Thread object and pruned of dead threads, so a long-lived
+process spawning short-lived workers doesn't pin their scratch instances
+forever, and OS thread-id reuse can't alias entries.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Generic, List, Tuple, TypeVar
+import weakref
+from typing import Callable, Generic, List, Tuple, TypeVar
 
 __all__ = ["ThreadLocalStore"]
 
@@ -29,7 +33,9 @@ class ThreadLocalStore(Generic[T]):
         self._factory = factory
         self._local = threading.local()
         self._lock = threading.Lock()
-        self._registry: Dict[int, Tuple[str, T]] = {}
+        self._registry: "weakref.WeakKeyDictionary[threading.Thread, T]" = (
+            weakref.WeakKeyDictionary()
+        )
 
     def get(self) -> T:
         try:
@@ -37,18 +43,21 @@ class ThreadLocalStore(Generic[T]):
         except AttributeError:
             value = self._factory()
             self._local.value = value
-            th = threading.current_thread()
             with self._lock:
-                self._registry[th.ident or id(th)] = (th.name, value)
+                self._registry[threading.current_thread()] = value
             return value
 
     def instances(self) -> List[Tuple[str, T]]:
-        """(thread name, instance) for every thread that called get()."""
+        """(thread name, instance) for every *live* thread that called get()."""
         with self._lock:
-            return list(self._registry.values())
+            return [
+                (th.name, value)
+                for th, value in list(self._registry.items())
+                if th.is_alive()
+            ]
 
     def clear(self) -> None:
         """Drop the registry (existing threads re-create on next get())."""
         with self._lock:
-            self._registry.clear()
+            self._registry = weakref.WeakKeyDictionary()
         self._local = threading.local()

@@ -28,6 +28,12 @@ from dmlc_core_tpu.io.recordio import (  # noqa: F401
     RECORDIO_MAGIC,
 )
 from dmlc_core_tpu.io.input_split import InputSplit  # noqa: F401
+from dmlc_core_tpu.io.lockfree import (  # noqa: F401
+    BlockingConcurrentQueue,
+    ConcurrentQueue,
+    QueueKilledError,
+    Spinlock,
+)
 
 # remote backends self-register their URI protocols on import
 from dmlc_core_tpu.io.s3_filesys import S3FileSystem  # noqa: F401

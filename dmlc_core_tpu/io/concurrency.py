@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Any, Generic, List, Optional, Tuple, TypeVar
 
 __all__ = ["ConcurrentBlockingQueue", "QueueKilled"]
@@ -45,10 +46,14 @@ class ConcurrentBlockingQueue(Generic[T]):
         self._not_full = threading.Condition(self._lock)
         self._killed = False
 
-    def push(self, value: T, priority: int = 0) -> None:
+    def push(self, value: T, priority: int = 0, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._not_full:
             while not self._killed and self._max > 0 and len(self._items) >= self._max:
-                self._not_full.wait()
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("ConcurrentBlockingQueue.push timed out")
+                self._not_full.wait(remaining)
             if self._killed:
                 raise QueueKilled()
             if self._priority:
@@ -59,10 +64,13 @@ class ConcurrentBlockingQueue(Generic[T]):
             self._not_empty.notify()
 
     def pop(self, timeout: Optional[float] = None) -> T:
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._not_empty:
             while not self._killed and not self._items:
-                if not self._not_empty.wait(timeout):
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
                     raise TimeoutError("ConcurrentBlockingQueue.pop timed out")
+                self._not_empty.wait(remaining)
             if self._killed and not self._items:
                 raise QueueKilled()
             if self._priority:
@@ -71,6 +79,35 @@ class ConcurrentBlockingQueue(Generic[T]):
                 value = self._items.pop(0)
             self._not_full.notify()
             return value
+
+    def try_push(self, value: T, priority: int = 0) -> bool:
+        """Non-blocking push; False when full (raises if killed)."""
+        with self._not_full:
+            if self._killed:
+                raise QueueKilled()
+            if self._max > 0 and len(self._items) >= self._max:
+                return False
+            if self._priority:
+                heapq.heappush(self._items, (priority, self._seq, value))
+                self._seq += 1
+            else:
+                self._items.append(value)
+            self._not_empty.notify()
+            return True
+
+    def try_pop(self) -> Tuple[bool, Optional[T]]:
+        """Non-blocking pop; (False, None) when empty (raises if killed+empty)."""
+        with self._not_empty:
+            if not self._items:
+                if self._killed:
+                    raise QueueKilled()
+                return False, None
+            if self._priority:
+                value = heapq.heappop(self._items)[2]
+            else:
+                value = self._items.pop(0)
+            self._not_full.notify()
+            return True, value
 
     def signal_for_kill(self) -> None:
         with self._lock:

@@ -13,6 +13,7 @@ reference's per-thread shutdown request).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base.logging import CHECK
@@ -92,8 +93,10 @@ class ThreadGroup:
             CHECK(name not in self._threads,
                   f"ThreadGroup: duplicate thread name {name!r}")
             t = _GroupThread(name, target, daemon=daemon)
+            # start before publishing: a concurrent join_all must never see
+            # an unstarted thread (Thread.join would raise RuntimeError)
+            t.start()
             self._threads[name] = t
-        t.start()
         return t
 
     def get(self, name: str) -> Optional[_GroupThread]:
@@ -114,14 +117,24 @@ class ThreadGroup:
         for t in threads:
             t.shutdown._set()
 
-    def join_all(self, timeout: Optional[float] = None) -> None:
+    def join_all(self, timeout: Optional[float] = None) -> List[str]:
+        """Join every thread; ``timeout`` bounds the TOTAL wait (one shared
+        deadline, not per-thread).  Returns the names of threads still alive
+        at the deadline (empty list = clean join); re-raises the first
+        worker exception."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             threads = list(self._threads.values())
+        still_alive: List[str] = []
         for t in threads:
-            t.join(timeout)
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            t.join(remaining)
+            if t.is_alive():
+                still_alive.append(t.name)
         for t in threads:
             if t.exc is not None:
                 raise t.exc
+        return still_alive
 
     def __enter__(self) -> "ThreadGroup":
         return self

@@ -154,6 +154,48 @@ def _leaf_sums(node, g, h, n_leaf):
             jax.ops.segment_sum(h, safe, num_segments=n_leaf))
 
 
+_LEAF_BLOCK_ROWS = 8192
+
+
+def _leaf_sums_matmul(node, g, h, n_leaf, block_rows=_LEAF_BLOCK_ROWS):
+    """Exact-f32 leaf grad/hess sums on the MXU → [2, n_leaf].
+
+    One [2, block]·[block, n_leaf] one-hot dot per scan step: HIGHEST
+    precision keeps leaf weights bit-comparable to the segment_sum/CPU path
+    (bf16 would round every g/h to 8 mantissa bits before accumulating),
+    while row-blocking caps the one-hot at block·n_leaf elements instead of
+    materializing the full [n, n_leaf] (segment_sum scatters serialize on
+    TPU, so the MXU still wins).  Rows with node < 0 match no leaf column
+    and contribute zero.
+    """
+    n = node.shape[0]
+    # even out block sizes rounded to sublane multiples (the _hist_matmul
+    # blocking scheme): a fixed block would pad up to block_rows-1 rows
+    nb = max(1, -(-n // block_rows))
+    per_blk = -(-n // nb)
+    R = -(-per_blk // 8) * 8
+    pad = nb * R - n
+    node_p = jnp.pad(node, (0, pad), constant_values=-1)
+    gh_p = jnp.pad(jnp.stack([g, h]), ((0, 0), (0, pad)))
+    block_rows = R
+    iota = jnp.arange(n_leaf, dtype=jnp.int32)[None, :]
+
+    def body(acc, blk):
+        node_b, gh_b = blk
+        oh = (node_b[:, None] == iota).astype(jnp.float32)
+        return acc + jax.lax.dot_general(
+            gh_b, oh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        ), None
+
+    blocks = (node_p.reshape(nb, block_rows),
+              gh_p.reshape(2, nb, block_rows).transpose(1, 0, 2))
+    acc, _ = jax.lax.scan(body, jnp.zeros((2, n_leaf), jnp.float32), blocks)
+    return acc
+
+
 class HistGBTParam(Parameter):
     """Hyperparameters (XGBoost-compatible names where they exist)."""
 
@@ -452,21 +494,7 @@ class HistGBT:
                     jnp.where(feat_sel[:, None] == f_iota,
                               bins_l.astype(jnp.int32), 0), axis=1)   # [n]
                 node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
-            # leaf grad/hess sums as ONE exact-f32 one-hot matmul: [2, n]
-            # · [n, n_leaf] with HIGHEST precision keeps leaf weights
-            # bit-comparable to the segment_sum/CPU path (bf16 here would
-            # round every g/h to 8 mantissa bits before accumulating);
-            # segment_sum scatters serialize on TPU, so MXU still wins
-            leaf_oh = (node[:, None]
-                       == jnp.arange(n_leaf, dtype=jnp.int32)[None, :]
-                       ).astype(jnp.float32)                     # [n, n_leaf]
-            lsum = jax.lax.dot_general(
-                jnp.stack([g, h]), leaf_oh,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32,
-            )                                                     # [2, n_leaf]
-            lsum = jax.lax.psum(lsum, "data")
+            lsum = jax.lax.psum(_leaf_sums_matmul(node, g, h, n_leaf), "data")
             gsum, hsum = lsum[0], lsum[1]
             leaf = -gsum / (hsum + lam) * eta
             preds_new = preds_l + table_select(leaf, node, n_leaf)

@@ -44,8 +44,8 @@ def device_trace(logdir: str) -> Iterator[None]:
     """
     import jax
 
-    os.makedirs(logdir, exist_ok=True)
     try:
+        os.makedirs(logdir, exist_ok=True)
         jax.profiler.start_trace(logdir)
         started = True
     except Exception:  # noqa: BLE001 — profiling must never break training

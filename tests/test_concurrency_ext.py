@@ -82,14 +82,22 @@ def test_thread_local_store_per_thread_instances():
     assert store.get() is main
     seen = {}
 
-    def worker():
-        seen["other"] = store.get()
+    barrier = threading.Barrier(2)
 
-    t = threading.Thread(target=worker)
+    def worker_waits():
+        seen["other"] = store.get()
+        barrier.wait()   # registered while alive
+        barrier.wait()   # released after the assertion below
+
+    t = threading.Thread(target=worker_waits)
     t.start()
-    t.join()
+    barrier.wait()
     assert seen["other"] is not main
-    assert len(store.instances()) == 2
+    assert len(store.instances()) == 2  # both threads still alive
+    barrier.wait()
+    t.join()
+    # dead threads are pruned: their instances are not pinned forever
+    assert len(store.instances()) == 1
     store.clear()
     assert store.instances() == []
     assert store.get() is not main  # re-created after clear

@@ -1,0 +1,170 @@
+"""Lock-free MPMC queue + Spinlock tests.
+
+Mirrors the reference's ``test/unittest/unittest_lockfree.cc`` strategy
+(SURVEY.md §4): N producers × M consumers hammer one queue; every pushed
+token must be popped exactly once; blocking ops honor timeouts and
+SignalForKill.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu.io.lockfree import (
+    BlockingConcurrentQueue,
+    ConcurrentQueue,
+    QueueKilledError,
+    Spinlock,
+    native_queue_available,
+)
+
+
+def test_native_engine_is_live():
+    # The build ships libdmlctpu.so; the lock-free engine must be the real
+    # one in CI, not the pure-Python fallback — unless the env explicitly
+    # disables it (DMLC_TPU_NATIVE_IO=0 re-runs this suite on the fallback).
+    import os
+
+    if os.environ.get("DMLC_TPU_NATIVE_IO", "1") == "0":
+        pytest.skip("native engine disabled via DMLC_TPU_NATIVE_IO=0")
+    assert native_queue_available()
+
+
+def test_try_enqueue_dequeue_fifo_single_thread():
+    q = ConcurrentQueue(capacity=8)
+    for i in range(8):
+        assert q.try_enqueue(("item", i))
+    assert not q.try_enqueue("overflow")
+    got = []
+    while True:
+        ok, v = q.try_dequeue()
+        if not ok:
+            break
+        got.append(v)
+    assert got == [("item", i) for i in range(8)]
+
+
+def test_size_approx():
+    q = ConcurrentQueue(capacity=16)
+    for i in range(5):
+        q.try_enqueue(i)
+    assert q.size_approx() == 5
+
+
+def test_mpmc_stress_every_token_once():
+    n_producers, n_consumers, per_producer = 4, 4, 2000
+    q = BlockingConcurrentQueue(capacity=64)
+    seen = []
+    seen_lock = threading.Lock()
+
+    def produce(pid):
+        for i in range(per_producer):
+            assert q.enqueue((pid, i))
+
+    def consume():
+        local = []
+        while True:
+            ok, v = q.dequeue(timeout=0.5)
+            if not ok:
+                break
+            if v is None:  # sentinel
+                break
+            local.append(v)
+        with seen_lock:
+            seen.extend(local)
+
+    consumers = [threading.Thread(target=consume) for _ in range(n_consumers)]
+    producers = [threading.Thread(target=produce, args=(p,)) for p in range(n_producers)]
+    for t in consumers + producers:
+        t.start()
+    for t in producers:
+        t.join()
+    for _ in range(n_consumers):
+        q.enqueue(None)
+    for t in consumers:
+        t.join()
+
+    assert len(seen) == n_producers * per_producer
+    assert set(seen) == {(p, i) for p in range(n_producers) for i in range(per_producer)}
+
+
+def test_blocking_dequeue_timeout():
+    q = BlockingConcurrentQueue(capacity=4)
+    t0 = time.monotonic()
+    ok, _ = q.dequeue(timeout=0.2)
+    dt = time.monotonic() - t0
+    assert not ok
+    assert dt >= 0.15
+
+
+def test_blocking_enqueue_timeout_when_full():
+    q = BlockingConcurrentQueue(capacity=2)
+    assert q.enqueue("a")
+    assert q.enqueue("b")
+    assert not q.enqueue("c", timeout=0.2)
+
+
+def test_enqueue_unblocks_blocked_dequeue():
+    q = BlockingConcurrentQueue(capacity=4)
+    result = {}
+
+    def consumer():
+        result["v"] = q.dequeue(timeout=5.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.1)
+    q.enqueue("wake")
+    t.join(timeout=5.0)
+    assert result["v"] == (True, "wake")
+
+
+def test_kill_wakes_blocked_consumers():
+    # works on both engines: native kill futex-wakes; the fallback delegates
+    # to ConcurrentBlockingQueue.signal_for_kill
+    q = BlockingConcurrentQueue(capacity=4)
+    errs = []
+
+    def consumer():
+        try:
+            q.dequeue(timeout=None)
+        except QueueKilledError:
+            errs.append(True)
+
+    threads = [threading.Thread(target=consumer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    q.kill()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert errs == [True, True, True]
+    with pytest.raises(QueueKilledError):
+        q.enqueue("after-kill")
+
+
+def test_spinlock_mutual_exclusion():
+    lock = Spinlock()
+    counter = {"v": 0}
+
+    def worker():
+        for _ in range(10000):
+            with lock:
+                counter["v"] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter["v"] == 40000
+
+
+def test_spinlock_trylock():
+    lock = Spinlock()
+    assert lock.try_acquire()
+    assert not lock.try_acquire()
+    lock.release()
+    assert lock.try_acquire()
+    lock.release()
