@@ -46,6 +46,24 @@ class ConcurrentBlockingQueue(Generic[T]):
         self._not_full = threading.Condition(self._lock)
         self._killed = False
 
+    def _do_push(self, value: T, priority: int) -> None:
+        """Insert + notify; caller holds the lock and checked capacity."""
+        if self._priority:
+            heapq.heappush(self._items, (priority, self._seq, value))
+            self._seq += 1
+        else:
+            self._items.append(value)
+        self._not_empty.notify()
+
+    def _do_pop(self) -> T:
+        """Remove + notify; caller holds the lock and checked emptiness."""
+        if self._priority:
+            value = heapq.heappop(self._items)[2]
+        else:
+            value = self._items.pop(0)
+        self._not_full.notify()
+        return value
+
     def push(self, value: T, priority: int = 0, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._not_full:
@@ -56,12 +74,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 self._not_full.wait(remaining)
             if self._killed:
                 raise QueueKilled()
-            if self._priority:
-                heapq.heappush(self._items, (priority, self._seq, value))
-                self._seq += 1
-            else:
-                self._items.append(value)
-            self._not_empty.notify()
+            self._do_push(value, priority)
 
     def pop(self, timeout: Optional[float] = None) -> T:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -73,12 +86,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 self._not_empty.wait(remaining)
             if self._killed and not self._items:
                 raise QueueKilled()
-            if self._priority:
-                value = heapq.heappop(self._items)[2]
-            else:
-                value = self._items.pop(0)
-            self._not_full.notify()
-            return value
+            return self._do_pop()
 
     def try_push(self, value: T, priority: int = 0) -> bool:
         """Non-blocking push; False when full (raises if killed)."""
@@ -87,12 +95,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 raise QueueKilled()
             if self._max > 0 and len(self._items) >= self._max:
                 return False
-            if self._priority:
-                heapq.heappush(self._items, (priority, self._seq, value))
-                self._seq += 1
-            else:
-                self._items.append(value)
-            self._not_empty.notify()
+            self._do_push(value, priority)
             return True
 
     def try_pop(self) -> Tuple[bool, Optional[T]]:
@@ -102,12 +105,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 if self._killed:
                     raise QueueKilled()
                 return False, None
-            if self._priority:
-                value = heapq.heappop(self._items)[2]
-            else:
-                value = self._items.pop(0)
-            self._not_full.notify()
-            return True, value
+            return True, self._do_pop()
 
     def signal_for_kill(self) -> None:
         with self._lock:

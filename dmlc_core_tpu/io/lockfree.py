@@ -39,12 +39,16 @@ _SO_PATHS = [
 ]
 
 _lib: Optional[ctypes.CDLL] = None
+_lib_checked = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
-    if _lib is not None:
+    global _lib, _lib_checked
+    if _lib_checked:
+        # negative results cached too: without this, every queue/lock
+        # construction in fallback mode re-stats all candidate paths
         return _lib
+    _lib_checked = True
     if os.environ.get("DMLC_TPU_NATIVE_IO", "1") == "0":
         return None
     for path in _SO_PATHS:

@@ -22,6 +22,7 @@ data plane inherits for long-context workloads (SURVEY.md §5).
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Iterator, Optional
 
@@ -61,9 +62,15 @@ def decode_length(lrec: int) -> int:
 
 
 class RecordIOWriter:
-    """Write records with magic-escaping.  Reference: ``RecordIOWriter``."""
+    """Write records with magic-escaping.  Reference: ``RecordIOWriter``.
 
-    def __init__(self, stream: Stream):
+    Accepts an open :class:`Stream` or a path/URI (opened for write via
+    ``Stream.create`` and owned/closed by the writer).
+    """
+
+    def __init__(self, stream):
+        if isinstance(stream, (str, os.PathLike)):
+            stream = Stream.create(str(stream), "w")
         self._stream = stream
         self.except_counter = 0  # number of embedded magics escaped
 
@@ -110,9 +117,15 @@ class RecordIOWriter:
 
 
 class RecordIOReader:
-    """Read records, reassembling escaped parts.  Reference: ``RecordIOReader``."""
+    """Read records, reassembling escaped parts.  Reference: ``RecordIOReader``.
 
-    def __init__(self, stream: Stream):
+    Accepts an open :class:`Stream` or a path/URI (opened for read via
+    ``Stream.create`` and owned/closed by the reader).
+    """
+
+    def __init__(self, stream):
+        if isinstance(stream, (str, os.PathLike)):
+            stream = Stream.create(str(stream), "r")
         self._stream = stream
 
     def close(self) -> None:

@@ -28,6 +28,7 @@ as a perf follow-up.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -102,21 +103,39 @@ class _SquaredError:
         return float(np.sqrt(m))
 
 
-def _make_best_split(B: int, lam: float, gamma: float, mcw: float):
+def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
+                     with_child_sums: bool = False):
     """Greedy per-node split chooser over a gradient histogram.
 
     hist [2,N,F,B] → (feat [N], thr [N]); degenerate split (feat 0,
     thr B-1 → everyone left) when gain ≤ gamma.  Shared by the in-core
     shard_map round and the external-memory page loop.
+
+    ``with_child_sums=True`` additionally returns the children's
+    ``(g_sum, h_sum)`` as ``[2N]`` arrays (leaf order: left=2i,
+    right=2i+1).  The cumsum evaluated at the chosen threshold IS the
+    left child's sum and parent − left the right's, so at the deepest
+    level the leaf g/h sums come for free from the histogram — no extra
+    pass over the rows (which an MXU-hostile ``[2,R]·[R,n_leaf]`` scan
+    previously spent ~99% of round time on).
+
+    Precision note: on TPU the histogram multiplies g/h by the one-hots
+    in bf16 (f32 accumulation), so leaf sums carry ~1e-3 relative
+    rounding per entry rather than being bit-identical to the CPU
+    segment-sum path.  Split selection always had this property (gain is
+    computed from the same histogram); extending it to leaf weights is
+    the deliberate price of eliminating the dominant per-round pass.
     """
 
     def best_split(hist):
         g = hist[0]
         h = hist[1]
-        gl = jnp.cumsum(g, axis=-1)[..., :-1]        # [N,F,B-1] left: bin ≤ b
-        hl = jnp.cumsum(h, axis=-1)[..., :-1]
-        gt = jnp.sum(g, axis=-1, keepdims=True)      # [N,F,1]
-        ht = jnp.sum(h, axis=-1, keepdims=True)
+        cg = jnp.cumsum(g, axis=-1)                  # [N,F,B] left-incl. sums
+        ch = jnp.cumsum(h, axis=-1)
+        gl = cg[..., :-1]                            # [N,F,B-1] left: bin ≤ b
+        hl = ch[..., :-1]
+        gt = cg[..., -1:]                            # [N,F,1]
+        ht = ch[..., -1:]
         gr = gt - gl
         hr = ht - hl
         gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam) - gt**2 / (ht + lam))
@@ -130,7 +149,18 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float):
         split_ok = 0.5 * best_gain > gamma
         feat = jnp.where(split_ok, feat, 0)
         thr = jnp.where(split_ok, thr, B - 1)        # bins ≤ B-1 → all left
-        return feat, thr
+        if not with_child_sums:
+            return feat, thr
+        N, F = g.shape[0], g.shape[1]
+        n_idx = jnp.arange(N, dtype=jnp.int32)
+        flat_idx = (n_idx * F + feat) * B + thr
+        lg = cg.reshape(-1)[flat_idx]                # left-child sums [N]
+        lh = ch.reshape(-1)[flat_idx]
+        tg = cg[:, 0, -1]                            # node totals (any feature)
+        th_ = ch[:, 0, -1]
+        child_g = jnp.stack([lg, tg - lg], axis=1).reshape(2 * N)
+        child_h = jnp.stack([lh, th_ - lh], axis=1).reshape(2 * N)
+        return feat, thr, child_g, child_h
 
     return best_split
 
@@ -152,48 +182,6 @@ def _leaf_sums(node, g, h, n_leaf):
     safe = jnp.where(node >= 0, node, 0)  # padding rows carry g=h=0
     return (jax.ops.segment_sum(g, safe, num_segments=n_leaf),
             jax.ops.segment_sum(h, safe, num_segments=n_leaf))
-
-
-_LEAF_BLOCK_ROWS = 8192
-
-
-def _leaf_sums_matmul(node, g, h, n_leaf, block_rows=_LEAF_BLOCK_ROWS):
-    """Exact-f32 leaf grad/hess sums on the MXU → [2, n_leaf].
-
-    One [2, block]·[block, n_leaf] one-hot dot per scan step: HIGHEST
-    precision keeps leaf weights bit-comparable to the segment_sum/CPU path
-    (bf16 would round every g/h to 8 mantissa bits before accumulating),
-    while row-blocking caps the one-hot at block·n_leaf elements instead of
-    materializing the full [n, n_leaf] (segment_sum scatters serialize on
-    TPU, so the MXU still wins).  Rows with node < 0 match no leaf column
-    and contribute zero.
-    """
-    n = node.shape[0]
-    # even out block sizes rounded to sublane multiples (the _hist_matmul
-    # blocking scheme): a fixed block would pad up to block_rows-1 rows
-    nb = max(1, -(-n // block_rows))
-    per_blk = -(-n // nb)
-    R = -(-per_blk // 8) * 8
-    pad = nb * R - n
-    node_p = jnp.pad(node, (0, pad), constant_values=-1)
-    gh_p = jnp.pad(jnp.stack([g, h]), ((0, 0), (0, pad)))
-    block_rows = R
-    iota = jnp.arange(n_leaf, dtype=jnp.int32)[None, :]
-
-    def body(acc, blk):
-        node_b, gh_b = blk
-        oh = (node_b[:, None] == iota).astype(jnp.float32)
-        return acc + jax.lax.dot_general(
-            gh_b, oh,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        ), None
-
-    blocks = (node_p.reshape(nb, block_rows),
-              gh_p.reshape(2, nb, block_rows).transpose(1, 0, 2))
-    acc, _ = jax.lax.scan(body, jnp.zeros((2, n_leaf), jnp.float32), blocks)
-    return acc
 
 
 class HistGBTParam(Parameter):
@@ -283,22 +271,48 @@ class HistGBT:
             np.full(n + n_pad, p.base_score, np.float32), row_sharding
         )
 
-        round_fn = self._build_round_fn(F)
-        for _ in range(warmup_rounds):
-            # preds is donated by round_fn — warm up on a copy so the real
-            # buffer stays valid and model state is untouched
-            discard = round_fn(bins, y_d, w_d, jnp.copy(preds))
-            jax.block_until_ready(discard)
-        jax.block_until_ready(preds)
+        # chunk rounds: K boosting rounds per dispatch (lax.scan inside the
+        # jitted program).  Per-dispatch + per-fetch latency (hundreds of
+        # ms through a remote-device tunnel) would otherwise dominate the
+        # actual ~100ms of round compute; trees stay on device until the
+        # end of fit
+        K = min(p.n_trees, 25)
+        if eval_every:
+            # chunk boundaries must land on eval rounds (gcd, not min:
+            # eval_every=30 with K=25 would never hit done%30==0)
+            K = math.gcd(K, eval_every)
+        kfn = self._build_round_fn(F, K)
+        rem = p.n_trees % K
+        rem_fn = self._build_round_fn(F, rem) if rem else None
+        if warmup_rounds > 0:
+            # compile + cache-warm on a copy so the real buffer stays
+            # valid and model state is untouched (preds is donated).
+            # np.asarray (not block_until_ready): on remote-tunnel devices
+            # only a real data fetch proves execution finished
+            warm = kfn(bins, y_d, w_d, jnp.copy(preds))
+            np.asarray(warm[0][:1])
+            if rem_fn is not None:
+                warm = rem_fn(bins, y_d, w_d, jnp.copy(preds))
+                np.asarray(warm[0][:1])
+        np.asarray(preds[:1])
 
         t0 = get_time()
-        for r in range(p.n_trees):
-            preds, tree = round_fn(bins, y_d, w_d, preds)
-            self.trees.append(jax.tree.map(np.asarray, tree))
-            if eval_every and (r + 1) % eval_every == 0:
+        chunks: List[Any] = []
+        done = 0
+        while done < p.n_trees:
+            fn = kfn if p.n_trees - done >= K else rem_fn
+            preds, trees_k = fn(bins, y_d, w_d, preds)
+            chunks.append(trees_k)        # stacked [k, ...] device arrays
+            done += K if fn is kfn else rem
+            if eval_every and done % eval_every == 0:
                 loss = float(self._obj.metric(preds, y_d))
-                LOG("INFO", "round %d: %s=%.5f", r + 1, "loss", loss)
-        jax.block_until_ready(preds)
+                LOG("INFO", "round %d: %s=%.5f", done, "loss", loss)
+        for trees_k in chunks:            # ONE host fetch per chunk
+            t_np = jax.tree.map(np.asarray, trees_k)
+            k = t_np["leaf"].shape[0]
+            self.trees.extend(
+                {key: t_np[key][i] for key in t_np} for i in range(k))
+        np.asarray(preds[:1])             # real sync before stopping timer
         self.last_fit_seconds = get_time() - t0
         self._train_preds = preds
         self._n_real_rows = n
@@ -446,7 +460,9 @@ class HistGBT:
         return self
 
     # ------------------------------------------------------------------
-    def _build_round_fn(self, n_features: int):
+    def _build_round_fn(self, n_features: int, n_rounds: int = 1):
+        """Jitted shard_map program running ``n_rounds`` boosting rounds
+        (lax.scan); returns (new_preds, trees stacked [n_rounds, ...])."""
         p = self.param
         depth = p.max_depth
         B = p.n_bins
@@ -460,6 +476,8 @@ class HistGBT:
         half = max(n_leaf >> 1, 1)
 
         best_split = _make_best_split(B, lam, gamma, mcw)
+        best_split_leaf = _make_best_split(B, lam, gamma, mcw,
+                                           with_child_sums=True)
 
         def table_select(table, node, n_entries):
             """Gather-free ``table[node]`` for a tiny per-node table: a
@@ -477,11 +495,18 @@ class HistGBT:
             node = jnp.zeros(bins_l.shape[0], jnp.int32)
             feats = []
             thrs = []
+            gsum = hsum = None
             for level in range(depth):
                 n_nodes = 1 << level
                 hist = build_histogram(bins_l, node, g, h, n_nodes, B, method)
                 hist = jax.lax.psum(hist, "data")        # ← THE histogram sync
-                feat, thr = best_split(hist)
+                if level == depth - 1:
+                    # deepest level: the histogram cumsum at the chosen
+                    # threshold already IS the leaf g/h sums — no extra
+                    # pass over the rows needed
+                    feat, thr, gsum, hsum = best_split_leaf(hist)
+                else:
+                    feat, thr = best_split(hist)
                 # pad per-level arrays to a common width for stacking
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
@@ -494,8 +519,8 @@ class HistGBT:
                     jnp.where(feat_sel[:, None] == f_iota,
                               bins_l.astype(jnp.int32), 0), axis=1)   # [n]
                 node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
-            lsum = jax.lax.psum(_leaf_sums_matmul(node, g, h, n_leaf), "data")
-            gsum, hsum = lsum[0], lsum[1]
+            # gsum/hsum came from the (already psum'd) deepest histogram,
+            # so they are global — no further collective needed
             leaf = -gsum / (hsum + lam) * eta
             preds_new = preds_l + table_select(leaf, node, n_leaf)
             tree = {
@@ -505,8 +530,14 @@ class HistGBT:
             }
             return preds_new, tree
 
+        def k_rounds_body(bins_l, y_l, w_l, preds_l):
+            def step(preds_c, _):
+                return round_body(bins_l, y_l, w_l, preds_c)
+
+            return jax.lax.scan(step, preds_l, None, length=n_rounds)
+
         mapped = shard_map(
-            round_body,
+            k_rounds_body,
             mesh=self.mesh,
             in_specs=(P("data", None), P("data"), P("data"), P("data")),
             out_specs=(P("data"), P()),

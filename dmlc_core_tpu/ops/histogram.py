@@ -53,13 +53,14 @@ def histogram_methods() -> list[str]:
 
 
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
-    """The pallas kernel needs every per-feature one-hot slice
-    ``oh_ref[:, f·B:(f+1)·B]`` lane-aligned — i.e. ``n_bins % 128 == 0``,
-    not merely F·B — and a VMEM-resident accumulator (one-hot scratch
-    ~7MB at HIGGS shapes + [2N, F·B] f32)."""
-    fb = n_features * n_bins
-    vmem = 512 * fb * 2 + 2 * n_nodes * fb * 4
-    return n_bins % 128 == 0 and vmem <= 12 << 20
+    """The factored kernel works for any n_bins; the only requirement is
+    that its [F, 2·N·hi, lo] f32 accumulator plus the row tile's working
+    values stay VMEM-resident."""
+    lo = min(n_bins, 128)
+    hi = -(-n_bins // lo)
+    vmem = (n_features * 2 * n_nodes * hi * max(lo, 128) * 4   # accumulator
+            + 1024 * (n_features * 4 + 6 * 128 * 2))           # tile values
+    return vmem <= 12 << 20
 
 
 def build_histogram(
@@ -161,57 +162,76 @@ def _hist_matmul(bins, node_id, grad, hess, n_nodes, n_bins,
     return acc.reshape(2, n_nodes, F, n_bins)
 
 
-def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref, oh_ref):
-    """One row-tile: build the [R, F·B] bin one-hot IN VMEM and dot it.
+def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
+                        *, n_nodes, hi, lo):
+    """One row-tile of the FACTORED one-hot matmul.
 
-    The fusion is the whole point: the XLA matmul formulation writes the
-    one-hot to HBM every level (~F·B bytes/row/level — hundreds of GB per
-    round at HIGGS scale); here it lives in a VMEM scratch and never
-    leaves the chip, so HBM traffic drops to the bin matrix itself and the
-    VPU compare + one MXU dot set the pace (measured 3.2× over the XLA
-    matmul path at HIGGS shapes on v5e).
+    bin = hi_part·lo + lo_part.  Per feature, ONE MXU dot
+    ``[A, R] · [lo, R]ᵀ`` where the LHS one-hot encodes
+    (grad/hess plane, node, hi_part) scaled by g/h and the RHS encodes
+    lo_part.  With lo=128 and A = 2·N·hi ≤ 128 (true for every level of
+    a depth-≤6 tree at 256 bins) both MXU dimensions are FULL — the
+    naive ``[R, 2N]ᵀ·[R, F·B]`` layout pads 2N→128 sublanes and streams
+    B/128 lane-tiles, wasting ≥2× the MXU cycles.  One-hots live only in
+    VMEM values (never HBM); HBM traffic is the bin matrix itself.
 
-    Notes from target bring-up: one-hots are built per feature at
-    ``[R, B]`` (B on lanes — collapsing a 3D ``[R, F, B]`` is an
-    unsupported shape cast in Mosaic) and compares run in int32 (bf16 and
-    int16 vector compares are rejected by this target).
+    Layout: everything arrives TRANSPOSED (rows on lanes — bins [F, R],
+    node/g/h [1, R]) so the per-feature loop can be a fori_loop that
+    dynamically slices the ref's major dim; a Python unroll over 28
+    features blows the scoped-vmem stack, and Mosaic lowers neither
+    dynamic_slice on values nor lane-dim dynamic ref slices.  Vector
+    compares run in int32 (bf16/int16 compares rejected by this target).
     """
     i = pl.program_id(0)
-    R, F = bins_ref.shape
-    two_n, FB = out_ref.shape
-    B = FB // F
-    n_nodes = two_n // 2
+    F, R = bins_ref.shape
 
-    bins_i = bins_ref[:].astype(jnp.int32)                            # [R, F]
-    node = node_ref[:].astype(jnp.int32)                              # [R, 1]
-    n_iota = jax.lax.broadcasted_iota(jnp.int32, (R, n_nodes), 1)
-    node_oh = (n_iota == node).astype(jnp.bfloat16)  # node<0 → all-zero row
-    g = g_ref[:].astype(jnp.bfloat16)                                 # [R, 1]
+    node = node_ref[:].astype(jnp.int32)                              # [1, R]
+    g = g_ref[:].astype(jnp.bfloat16)                                 # [1, R]
     h = h_ref[:].astype(jnp.bfloat16)
-    lhs = jnp.concatenate([node_oh * g, node_oh * h], axis=1)         # [R, 2N]
-
-    b_iota = jax.lax.broadcasted_iota(jnp.int32, (R, B), 1)
-    for f in range(F):  # F is static; unrolled at trace time
-        oh_ref[:, f * B:(f + 1) * B] = (
-            bins_i[:, f:f + 1] == b_iota).astype(jnp.bfloat16)
 
     @pl.when(i == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    out_ref[:] += jax.lax.dot_general(
-        lhs, oh_ref[:],
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    a_iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes * hi, R), 0)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (lo, R), 0)
+
+    def body(fg, carry):
+        # feature GROUPS of 8: sublane-dim ref slices must be 8-aligned
+        # (pl.multiple_of proves it); within a group a static unroll —
+        # a full 28-feature unroll blows the scoped-vmem stack
+        base = pl.multiple_of(fg * 8, 8)
+        blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)           # [8, R]
+        for k in range(8):
+            bf = blk[k:k + 1]                                         # [1, R]
+            # node<0 (padding) → acol negative → matches no row → 0 col
+            acol = node * hi + bf // lo                               # [1, R]
+            oh = (a_iota == acol).astype(jnp.bfloat16)                # [N·hi, R]
+            lhs = jnp.concatenate([oh * g, oh * h], axis=0)           # [A, R]
+            rhs = (lo_iota == bf % lo).astype(jnp.bfloat16)           # [lo, R]
+            d = jax.lax.dot_general(
+                lhs, rhs,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                          # [A, lo]
+            idx = (pl.ds(fg * 8 + k, 1), slice(None), slice(None))
+            out_ref[idx] = out_ref[idx] + d[None]
+        return carry
+
+    jax.lax.fori_loop(0, F // 8, body, 0)
 
 
 @partial(jax.jit, static_argnums=(4, 5, 6))
 def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
-                 tile_rows: int = 512):
+                 tile_rows: int = 1024):
     """Pallas TPU path: grid over row tiles, all tiles accumulate into the
-    same [2N, F·B] VMEM output block (sequential TPU grid ⇒ safe)."""
+    same [F, A, lo] VMEM output block (sequential TPU grid ⇒ safe), then
+    one small reshape/transpose back to [2, N, F, B]."""
     n, F = bins.shape
+    lo = min(n_bins, 128)
+    hi = -(-n_bins // lo)
+    A = 2 * n_nodes * hi
+    Fp = -(-F // 8) * 8          # feature groups of 8 (sublane alignment)
     pad = (-n) % tile_rows
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
@@ -220,24 +240,25 @@ def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
         hess = jnp.pad(hess, (0, pad))
     n_pad = n + pad
     grid = n_pad // tile_rows
-    from jax.experimental.pallas import tpu as pltpu
+    bins_t = jnp.pad(bins.T, ((0, Fp - F), (0, 0)))
 
     out = pl.pallas_call(
-        _hist_pallas_kernel,
-        out_shape=jax.ShapeDtypeStruct((2 * n_nodes, F * n_bins), jnp.float32),
+        partial(_hist_pallas_kernel, n_nodes=n_nodes, hi=hi, lo=lo),
+        out_shape=jax.ShapeDtypeStruct((Fp, A, lo), jnp.float32),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((tile_rows, F), lambda i: (i, 0)),
-            pl.BlockSpec((tile_rows, 1), lambda i: (i, 0)),
-            pl.BlockSpec((tile_rows, 1), lambda i: (i, 0)),
-            pl.BlockSpec((tile_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((Fp, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((2 * n_nodes, F * n_bins), lambda i: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((tile_rows, F * n_bins), jnp.bfloat16)],
+        out_specs=pl.BlockSpec((Fp, A, lo), lambda i: (0, 0, 0)),
         interpret=jax.default_backend() != "tpu",
-    )(bins, node_id.reshape(n_pad, 1), grad.reshape(n_pad, 1),
-      hess.reshape(n_pad, 1))
-    return out.reshape(2, n_nodes, F, n_bins)
+    )(bins_t, node_id.reshape(1, n_pad), grad.reshape(1, n_pad),
+      hess.reshape(1, n_pad))
+    # [Fp, (gh, N, hi), lo] → [gh, N, F, hi·lo] → slice feature/bin pads
+    out = out[:F].reshape(F, 2, n_nodes, hi * lo).transpose(1, 2, 0, 3)
+    return out[..., :n_bins]
 
 
 def reference_histogram(bins, node_id, grad, hess, n_nodes, n_bins):

@@ -1,0 +1,91 @@
+"""Isolate descent cost; compare formulations (chained, one fetch)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts
+
+ROWS, F, B, DEPTH = 4_000_000, 28, 256, 6
+ITERS = int(os.environ.get("ITERS", 8))
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(ROWS, F)).astype(np.float32)
+bins = apply_bins(jnp.asarray(X), compute_cuts(X, B))
+np.asarray(bins[0])
+feats = jnp.asarray(rng.integers(0, F, (DEPTH, 32)).astype(np.int32))
+thrs = jnp.asarray(rng.integers(0, B, (DEPTH, 32)).astype(np.int32))
+
+
+def table_select(table, node, n_entries):
+    n_iota = jnp.arange(n_entries, dtype=jnp.int32)[None, :]
+    oh = node[:, None] == n_iota
+    return jnp.sum(jnp.where(oh, table[None, :], 0), axis=1)
+
+
+@jax.jit
+def six_descents_select(bins_l, feats, thrs):
+    node = jnp.zeros(bins_l.shape[0], jnp.int32)
+    for level in range(DEPTH):
+        n_nodes = 1 << level
+        feat = feats[level, :n_nodes]
+        thr = thrs[level, :n_nodes]
+        feat_sel = table_select(feat, node, n_nodes)
+        thr_sel = table_select(thr, node, n_nodes)
+        f_iota = jnp.arange(bins_l.shape[1], dtype=jnp.int32)[None, :]
+        row_bin = jnp.sum(
+            jnp.where(feat_sel[:, None] == f_iota,
+                      bins_l.astype(jnp.int32), 0), axis=1)
+        node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
+    return node
+
+
+@jax.jit
+def six_descents_gather(bins_l, feats, thrs):
+    node = jnp.zeros(bins_l.shape[0], jnp.int32)
+    for level in range(DEPTH):
+        n_nodes = 1 << level
+        feat = feats[level, :n_nodes]
+        thr = thrs[level, :n_nodes]
+        f = feat[node]
+        t = thr[node]
+        row_bin = jnp.take_along_axis(
+            bins_l, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        node = 2 * node + (row_bin > t).astype(jnp.int32)
+    return node
+
+
+@jax.jit
+def one_descent_select(bins_l, feats, thrs, node):
+    n_nodes = 32
+    feat = feats[5, :n_nodes]
+    thr = thrs[5, :n_nodes]
+    feat_sel = table_select(feat, node, n_nodes)
+    thr_sel = table_select(thr, node, n_nodes)
+    f_iota = jnp.arange(bins_l.shape[1], dtype=jnp.int32)[None, :]
+    row_bin = jnp.sum(
+        jnp.where(feat_sel[:, None] == f_iota,
+                  bins_l.astype(jnp.int32), 0), axis=1)
+    return 2 * node + (row_bin > thr_sel).astype(jnp.int32)
+
+
+def timed(label, fn, *args):
+    out = fn(*args)
+    np.asarray(out)[:1]
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    _ = np.asarray(out)[:1]
+    print(f"{label:40s} {(time.perf_counter()-t0)/ITERS*1e3:9.1f} ms",
+          flush=True)
+
+
+nid = jnp.asarray(rng.integers(0, 32, ROWS).astype(np.int32))
+timed("6 descents (table_select)", six_descents_select, bins, feats, thrs)
+timed("6 descents (gather)", six_descents_gather, bins, feats, thrs)
+timed("1 descent lvl5 (table_select)", one_descent_select, bins, feats, thrs, nid)

@@ -1,0 +1,105 @@
+"""Trustworthy piecewise profile: chain N iterations of one piece on
+device, then force a real D2H fetch; tunnel-proof timing."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.ops.histogram import build_histogram
+from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts
+
+ROWS = int(os.environ.get("ROWS", 4_000_000))
+F, B, DEPTH = 28, 256, 6
+ITERS = int(os.environ.get("ITERS", 10))
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(ROWS, F)).astype(np.float32)
+bins = apply_bins(jnp.asarray(X), compute_cuts(X, B))
+g0 = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+h0 = jnp.abs(g0) + 0.1
+nid32 = jnp.asarray(rng.integers(0, 32, ROWS).astype(np.int32))
+np.asarray(bins[0])  # sync
+
+
+def timed(label, fn, *args):
+    out = fn(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]  # compile+sync
+    t0 = time.perf_counter()
+    for _i in range(ITERS):
+        out = fn(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]  # real fetch
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{label:46s} {dt*1e3:9.2f} ms", flush=True)
+    return dt
+
+
+# histogram at each level, pallas
+for lvl in (0, 3, 5):
+    N = 1 << lvl
+    timed(f"hist pallas N={N}",
+          lambda b, nd, gg, hh, NN=N: build_histogram(b, nd % NN, gg, hh, NN, B, "pallas"),
+          bins, nid32, g0, h0)
+
+# grad/hess
+y = jnp.asarray((rng.random(ROWS) > 0.5).astype(np.float32))
+
+
+@jax.jit
+def gh(pred, yy):
+    p = jax.nn.sigmoid(pred)
+    return p - yy, p * (1 - p)
+
+
+timed("grad/hess", gh, jnp.zeros(ROWS, jnp.float32), y)
+
+
+# descent (table_select + row_bin) at level 5
+@jax.jit
+def descend(bins_l, node, feat, thr):
+    n_nodes = feat.shape[0]
+    n_iota = jnp.arange(n_nodes, dtype=jnp.int32)[None, :]
+    oh = node[:, None] == n_iota
+    feat_sel = jnp.sum(jnp.where(oh, feat[None, :], 0), axis=1)
+    thr_sel = jnp.sum(jnp.where(oh, thr[None, :], 0), axis=1)
+    f_iota = jnp.arange(bins_l.shape[1], dtype=jnp.int32)[None, :]
+    row_bin = jnp.sum(
+        jnp.where(feat_sel[:, None] == f_iota, bins_l.astype(jnp.int32), 0),
+        axis=1)
+    return 2 * node + (row_bin > thr_sel).astype(jnp.int32)
+
+
+feat32 = jnp.zeros(32, jnp.int32)
+thr32 = jnp.full(32, 128, jnp.int32)
+timed("descend N=32 (table_select+row_bin)", descend,
+      bins, nid32, feat32, thr32)
+
+
+# leaf update: preds + table_select(leaf, node)
+@jax.jit
+def leafupd(preds, leaf, node):
+    n_iota = jnp.arange(leaf.shape[0], dtype=jnp.int32)[None, :]
+    oh = node[:, None] == n_iota
+    return preds + jnp.sum(jnp.where(oh, leaf[None, :], 0.0), axis=1)
+
+
+timed("leaf update (table_select 64)", leafupd,
+      jnp.zeros(ROWS, jnp.float32), jnp.zeros(64, jnp.float32), nid32)
+
+# full hist sweep: all 6 levels chained (mimics one round's hist work)
+@jax.jit
+def hist_sweep(b, nd, gg, hh):
+    tot = 0.0
+    for lvl in range(DEPTH):
+        N = 1 << lvl
+        hist = build_histogram(b, nd % N, gg, hh, N, B, "pallas")
+        tot = tot + hist.sum()
+    return tot
+
+
+timed("hist sweep levels 0-5 (one round's hists)", hist_sweep,
+      bins, nid32, g0, h0)

@@ -1,0 +1,105 @@
+"""Break down where hist-GBT round time goes on the real chip.
+
+Times each component of a boosting round separately at bench shapes:
+histogram per level (pallas + matmul), descent (table_select/row_bin),
+leaf sums, grad/hess, and the full fused round_fn.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.ops.histogram import build_histogram
+from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts
+
+ROWS = int(os.environ.get("ROWS", 4_000_000))
+F = 28
+B = 256
+DEPTH = 6
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(ROWS, F)).astype(np.float32)
+cuts = compute_cuts(X, B)
+bins = apply_bins(jnp.asarray(X), cuts)
+bins = jax.block_until_ready(bins)
+print("bins dtype", bins.dtype, flush=True)
+
+g = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+h = jnp.abs(g) + 0.1
+node_per_level = {}
+node = jnp.zeros(ROWS, jnp.int32)
+for lvl in range(DEPTH):
+    node_per_level[lvl] = node % (1 << lvl)
+
+
+def timeit(fn, *args, n=5, label=""):
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:40s} {dt*1e3:8.2f} ms", flush=True)
+    return dt
+
+
+total_hist = {}
+for method in ("pallas", "matmul"):
+    tot = 0.0
+    for lvl in range(DEPTH):
+        n_nodes = 1 << lvl
+        nid = node_per_level[lvl]
+        tot += timeit(
+            lambda b, nd, gg, hh, nn=n_nodes, m=method: build_histogram(
+                b, nd, gg, hh, nn, B, m),
+            bins, nid, g, h, label=f"hist[{method}] level {lvl} (N={n_nodes})")
+    total_hist[method] = tot
+    print(f"  == total hist {method}: {tot*1e3:.1f} ms", flush=True)
+
+# descent cost at deepest level
+def descend(bins_l, node, feat, thr):
+    n_nodes = feat.shape[0]
+    n_iota = jnp.arange(n_nodes, dtype=jnp.int32)[None, :]
+    oh = node[:, None] == n_iota
+    feat_sel = jnp.sum(jnp.where(oh, feat[None, :], 0), axis=1)
+    thr_sel = jnp.sum(jnp.where(oh, thr[None, :], 0), axis=1)
+    f_iota = jnp.arange(bins_l.shape[1], dtype=jnp.int32)[None, :]
+    row_bin = jnp.sum(
+        jnp.where(feat_sel[:, None] == f_iota, bins_l.astype(jnp.int32), 0),
+        axis=1)
+    return 2 * node + (row_bin > thr_sel).astype(jnp.int32)
+
+
+feat32 = jnp.zeros(32, jnp.int32)
+thr32 = jnp.full(32, 128, jnp.int32)
+timeit(jax.jit(descend), bins, node_per_level[5], feat32, thr32,
+       label="descend level 5 (N=32)")
+
+from dmlc_core_tpu.models.histgbt import _leaf_sums_matmul
+timeit(lambda nd, gg, hh: _leaf_sums_matmul(nd, gg, hh, 64),
+       node_per_level[5], g, h, label="leaf_sums_matmul (64 leaves)")
+
+
+def grad_hess(pred, y):
+    p = jax.nn.sigmoid(pred)
+    return p - y, p * (1.0 - p)
+
+
+y = jnp.asarray((rng.random(ROWS) > 0.5).astype(np.float32))
+pred = jnp.zeros(ROWS, jnp.float32)
+timeit(jax.jit(grad_hess), pred, y, label="grad/hess")
+
+# full round via the model
+from dmlc_core_tpu.models import HistGBT
+from dmlc_core_tpu.parallel.mesh import local_mesh
+
+model = HistGBT(n_trees=1, max_depth=DEPTH, n_bins=B, mesh=local_mesh())
+Xn = np.asarray(X)
+yn = np.asarray(y)
+model.fit(Xn, yn, warmup_rounds=2)
+print(f"full round (model.fit 1 round): {model.last_fit_seconds*1e3:.1f} ms",
+      flush=True)

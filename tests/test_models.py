@@ -50,14 +50,17 @@ class TestHistogram:
         ref = reference_histogram(bins, node, g, h, N, B)
         np.testing.assert_allclose(out, ref, atol=2e-2, rtol=1e-2)  # bf16 dot
 
-    def test_pallas_guard_rejects_unaligned_bins(self):
+    def test_pallas_guard(self):
         from dmlc_core_tpu.ops.histogram import _pallas_ok
 
-        # F·B %128==0 but B itself unaligned — the case the kernel cannot
-        # tile (per-feature lane slices) and the guard must reject
-        assert not _pallas_ok(32, 8)
+        # the factored kernel handles any n_bins (incl. unaligned); only a
+        # VMEM blow-up (huge F·N·B accumulator) must be rejected
+        assert _pallas_ok(32, 8)
         assert _pallas_ok(128, 8)
+        assert _pallas_ok(200, 5)      # unaligned bins OK now
         assert _pallas_ok(256, 28)     # HIGGS shape
+        assert _pallas_ok(256, 28, n_nodes=32)
+        assert not _pallas_ok(256, 512, n_nodes=64)  # accumulator >> VMEM
 
     def test_negative_node_rows_ignored(self, rng):
         n, F, B, N = 100, 3, 8, 2
