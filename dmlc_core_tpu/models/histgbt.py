@@ -28,7 +28,6 @@ as a perf follow-up.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -278,9 +277,10 @@ class HistGBT:
         # end of fit
         K = min(p.n_trees, 25)
         if eval_every:
-            # chunk boundaries must land on eval rounds (gcd, not min:
-            # eval_every=30 with K=25 would never hit done%30==0)
-            K = math.gcd(K, eval_every)
+            # chunk boundaries must land on eval rounds: use the largest
+            # divisor of eval_every ≤ K (gcd alone would collapse to 1
+            # for e.g. eval_every=7, paying per-dispatch latency 7×)
+            K = max(d for d in range(1, K + 1) if eval_every % d == 0)
         kfn = self._build_round_fn(F, K)
         rem = p.n_trees % K
         rem_fn = self._build_round_fn(F, rem) if rem else None

@@ -52,6 +52,9 @@ def histogram_methods() -> list[str]:
     return ["auto", "segment", "matmul", "pallas"]
 
 
+_TILE_ROWS = 1024  # pallas row-tile; shared by the kernel and its guard
+
+
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
     """The factored kernel works for any n_bins; the only requirement is
     that its [F, 2·N·hi, lo] f32 accumulator plus the row tile's working
@@ -59,7 +62,7 @@ def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
     lo = min(n_bins, 128)
     hi = -(-n_bins // lo)
     vmem = (n_features * 2 * n_nodes * hi * max(lo, 128) * 4   # accumulator
-            + 1024 * (n_features * 4 + 6 * 128 * 2))           # tile values
+            + _TILE_ROWS * (n_features * 4 + 6 * 128 * 2))     # tile values
     return vmem <= 12 << 20
 
 
@@ -223,7 +226,7 @@ def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
 
 @partial(jax.jit, static_argnums=(4, 5, 6))
 def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
-                 tile_rows: int = 1024):
+                 tile_rows: int = _TILE_ROWS):
     """Pallas TPU path: grid over row tiles, all tiles accumulate into the
     same [F, A, lo] VMEM output block (sequential TPU grid ⇒ safe), then
     one small reshape/transpose back to [2, N, F, B]."""

@@ -44,7 +44,8 @@ from dmlc_core_tpu.base.parameter import get_env
 __all__ = [
     "init", "finalize", "rank", "world_size", "is_distributed",
     "allreduce", "broadcast", "allgather", "barrier",
-    "device_allreduce", "device_allgather", "replicate_fwd_psum_bwd",
+    "device_allreduce", "device_allgather", "device_reduce_scatter",
+    "replicate_fwd_psum_bwd",
     "get_tree", "find_share_ring", "get_link_map",
 ]
 
@@ -262,6 +263,40 @@ def _jitted_allgather(mesh: Mesh, axis: str):
 def device_allgather(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
     """All-gather shards over a mesh axis (XLA AllGather on ICI)."""
     return _jitted_allgather(mesh, axis)(x)
+
+
+@lru_cache(maxsize=None)
+def _jitted_reduce_scatter(mesh: Mesh, axis: str, op: str):
+    def _rs(full):
+        if op == "sum":
+            return jax.lax.psum_scatter(full, axis, tiled=True)
+        # max/min have no fused scatter primitive: reduce then slice
+        red = (jax.lax.pmax if op == "max" else jax.lax.pmin)(full, axis)
+        k = jax.lax.axis_size(axis)
+        i = jax.lax.axis_index(axis)
+        piece = full.shape[0] // k
+        return jax.lax.dynamic_slice_in_dim(red, i * piece, piece, axis=0)
+
+    return jax.jit(partial(shard_map, mesh=mesh, in_specs=P(),
+                           out_specs=P(axis), check_vma=False)(_rs))
+
+
+def device_reduce_scatter(x: jax.Array, mesh: Mesh, op: str = "sum",
+                          axis: str = "data") -> jax.Array:
+    """Reduce over the mesh axis, leaving each device its 1/k slice of
+    dim 0 (XLA ReduceScatter on ICI) — the bandwidth-optimal half of an
+    allreduce, the building block for ZeRO-style sharded optimizers.
+
+    ``x`` is replicated input with dim 0 divisible by the axis size; the
+    result is sharded over ``axis`` along dim 0.
+    """
+    if op not in ("sum", "max", "min"):
+        log_fatal(f"reduce_scatter: unknown op {op!r}; valid: sum/max/min")
+    if x.shape[0] % mesh.shape[axis]:
+        log_fatal(
+            f"reduce_scatter: dim 0 ({x.shape[0]}) not divisible by "
+            f"axis {axis!r} size {mesh.shape[axis]}")
+    return _jitted_reduce_scatter(mesh, axis, op)(x)
 
 
 # ---------------------------------------------------------------------------

@@ -79,11 +79,6 @@ thr32 = jnp.full(32, 128, jnp.int32)
 timeit(jax.jit(descend), bins, node_per_level[5], feat32, thr32,
        label="descend level 5 (N=32)")
 
-from dmlc_core_tpu.models.histgbt import _leaf_sums_matmul
-timeit(lambda nd, gg, hh: _leaf_sums_matmul(nd, gg, hh, 64),
-       node_per_level[5], g, h, label="leaf_sums_matmul (64 leaves)")
-
-
 def grad_hess(pred, y):
     p = jax.nn.sigmoid(pred)
     return p - y, p * (1.0 - p)

@@ -267,3 +267,50 @@ def test_get_env_unparseable_raises_error(monkeypatch):
     monkeypatch.setenv("DMLC_BAD", "notanint")
     with pytest.raises(Error, match="DMLC_BAD"):
         get_env("DMLC_BAD", 3)
+
+
+class TestMemoryPool:
+    def test_object_pool_reuses(self):
+        from dmlc_core_tpu.utils.memory import MemoryPool
+
+        made = []
+        pool = MemoryPool(lambda: made.append(1) or {"v": 0},
+                          reset=lambda o: o.update(v=0))
+        a = pool.alloc()
+        a["v"] = 7
+        pool.free(a)
+        b = pool.alloc()
+        assert b is a and b["v"] == 0        # recycled + reset
+        assert pool.allocated == 1 and len(made) == 1
+
+    def test_max_free_bound(self):
+        from dmlc_core_tpu.utils.memory import MemoryPool
+
+        pool = MemoryPool(dict, max_free=1)
+        x, y = pool.alloc(), pool.alloc()
+        pool.free(x)
+        pool.free(y)                          # dropped, over bound
+        assert pool.free_count() == 1
+
+    def test_buffer_pool_keyed_by_shape_dtype(self):
+        import numpy as np
+        from dmlc_core_tpu.utils.memory import BufferPool
+
+        bp = BufferPool()
+        a = bp.take((4, 3), np.float32)
+        bp.give(a)
+        b = bp.take((4, 3), np.float32)
+        assert b is a
+        c = bp.take((4, 3), np.int32)         # different dtype → fresh
+        assert c is not a and c.dtype == np.int32
+
+
+def test_param_doc_string():
+    class D(Parameter):
+        depth = field(int, default=3, lower_bound=1, upper_bound=10,
+                      description="tree depth")
+        act = field(str, default="relu", enum=["relu", "tanh"])
+
+    doc = D.doc_string()
+    assert "depth" in doc and "tree depth" in doc and ">=1" in doc
+    assert "relu" in doc

@@ -313,3 +313,44 @@ class TestMultiProcessLocal:
 
         tracker_submit(2, 0, fun_submit, host_ip="127.0.0.1")
         assert codes == [0, 0]
+
+
+class TestReduceScatter:
+    def test_sum_matches_allreduce_slice(self):
+        import jax
+        import numpy as np
+        from dmlc_core_tpu.parallel import collectives as coll
+        from dmlc_core_tpu.parallel.mesh import local_mesh
+
+        mesh = local_mesh()
+        k = mesh.shape["data"]
+        x = jnp.asarray(np.arange(8 * k * 3, dtype=np.float32).reshape(k * 4, 6))
+        out = coll.device_reduce_scatter(x, mesh, "sum")
+        # replicated input ⇒ reduce over axis = k·x; each shard holds its slice
+        want = np.asarray(x) * k
+        got = np.asarray(out)
+        np.testing.assert_allclose(got, want)
+
+    def test_max(self):
+        import numpy as np
+        from dmlc_core_tpu.parallel import collectives as coll
+        from dmlc_core_tpu.parallel.mesh import local_mesh
+
+        mesh = local_mesh()
+        k = mesh.shape["data"]
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(k * 2, 4)).astype(np.float32))
+        got = np.asarray(coll.device_reduce_scatter(x, mesh, "max"))
+        np.testing.assert_allclose(got, np.asarray(x))  # max of replicas = x
+
+    def test_indivisible_rejected(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.parallel import collectives as coll
+        from dmlc_core_tpu.parallel.mesh import local_mesh
+
+        mesh = local_mesh()
+        if mesh.shape["data"] == 1:
+            pytest.skip("needs >1 device")
+        bad = jnp.zeros((mesh.shape["data"] + 1, 2))
+        with pytest.raises(Error):
+            coll.device_reduce_scatter(bad, mesh)
