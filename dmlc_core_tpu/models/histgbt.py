@@ -126,7 +126,7 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
     the deliberate price of eliminating the dominant per-round pass.
     """
 
-    def best_split(hist):
+    def best_split(hist, feat_mask=None):
         g = hist[0]
         h = hist[1]
         cg = jnp.cumsum(g, axis=-1)                  # [N,F,B] left-incl. sums
@@ -140,6 +140,8 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam) - gt**2 / (ht + lam))
         ok = (hl >= mcw) & (hr >= mcw)
         gain = jnp.where(ok, gain, -jnp.inf)
+        if feat_mask is not None:                    # colsample: [F] bool
+            gain = jnp.where(feat_mask[None, :, None], gain, -jnp.inf)
         flat = gain.reshape(gain.shape[0], -1)       # [N, F*(B-1)]
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
@@ -197,6 +199,12 @@ class HistGBTParam(Parameter):
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror"])
     base_score = field(float, default=0.0, description="initial raw margin")
+    subsample = field(float, default=1.0, lower_bound=0.0, upper_bound=1.0,
+                      description="per-round row subsampling rate")
+    colsample_bytree = field(float, default=1.0, lower_bound=0.0,
+                             upper_bound=1.0,
+                             description="per-tree feature sampling rate")
+    seed = field(int, default=0, description="PRNG seed for sampling")
     hist_method = field(str, default="auto",
                         enum=["auto", "segment", "matmul", "pallas"],
                         description="histogram engine (ops.histogram)")
@@ -219,11 +227,19 @@ class HistGBT:
             self.param.init(kwargs)
         self.mesh = mesh if mesh is not None else local_mesh()
         CHECK("data" in self.mesh.axis_names, "mesh needs a 'data' axis")
+        # the field system's bounds are inclusive; 0.0 would silently
+        # train all-degenerate trees (XGBoost restricts to (0, 1])
+        CHECK(self.param.subsample > 0.0, "subsample must be in (0, 1]")
+        CHECK(self.param.colsample_bytree > 0.0,
+              "colsample_bytree must be in (0, 1]")
         self._obj = OBJECTIVES[self.param.objective]
         self.cuts: Optional[jax.Array] = None          # [F, n_bins-1]
         self.trees: List[Dict[str, np.ndarray]] = []   # per-tree arrays
         self._round_fn = None
         self.last_fit_seconds: Optional[float] = None
+        self.best_iteration: Optional[int] = None
+        self.best_score: Optional[float] = None
+        self._early_stopped = False
 
     # ------------------------------------------------------------------
     # training
@@ -236,20 +252,41 @@ class HistGBT:
         eval_every: int = 0,
         warmup_rounds: int = 0,
         cuts: Optional[jax.Array] = None,
+        eval_set: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        early_stopping_rounds: int = 0,
     ) -> "HistGBT":
         """Boost ``n_trees`` rounds.  ``warmup_rounds`` extra rounds are run
         and discarded first (compile + cache warm) so benchmark timing via
         ``last_fit_seconds`` covers steady state only.  ``cuts`` injects
         precomputed bin boundaries (else weighted quantile cuts are
-        computed, merged across workers)."""
+        computed, merged across workers).
+
+        ``eval_set=(Xv, yv)`` tracks validation loss at chunk boundaries;
+        with ``early_stopping_rounds`` boosting stops once the validation
+        loss hasn't improved for that many rounds (checked at chunk
+        granularity, like XGBoost's per-iteration check rounded up).
+        ``best_iteration``/``best_score`` record the winner and
+        :meth:`predict` then uses trees up to ``best_iteration+1`` by
+        default."""
         p = self.param
         X = np.ascontiguousarray(X, dtype=np.float32)
         y = np.ascontiguousarray(y, dtype=np.float32)
         n, F = X.shape
         CHECK_EQ(len(y), n, "X/y row mismatch")
+        if early_stopping_rounds:
+            CHECK(eval_set is not None,
+                  "early_stopping_rounds needs an eval_set")
 
-        self.cuts = cuts if cuts is not None else compute_cuts(
-            X, p.n_bins, weight=weight, allgather_fn=self._maybe_allgather())
+        # continued training (xgb_model semantics): keep the existing bin
+        # boundaries — the loaded trees' thresholds are only meaningful
+        # against them — and start margins from the existing ensemble
+        continuing = len(self.trees) > 0
+        if continuing:
+            CHECK(self.cuts is not None, "continue-fit without cuts")
+        else:
+            self.cuts = cuts if cuts is not None else compute_cuts(
+                X, p.n_bins, weight=weight,
+                allgather_fn=self._maybe_allgather())
         ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         n_pad = (-n) % ndev
         if n_pad:
@@ -266,9 +303,13 @@ class HistGBT:
         bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
         y_d = jax.device_put(y, row_sharding)
         w_d = jax.device_put(mask, row_sharding)
-        preds = jax.device_put(
-            np.full(n + n_pad, p.base_score, np.float32), row_sharding
-        )
+        init_margin = np.full(n + n_pad, p.base_score, np.float32)
+        if continuing:
+            stacked = self._stacked_trees(self.trees)
+            init_margin = np.asarray(_predict_trees(
+                bins, stacked["feat"], stacked["thr"], stacked["leaf"],
+                p.max_depth, p.base_score)).astype(np.float32)
+        preds = jax.device_put(init_margin, row_sharding)
 
         # chunk rounds: K boosting rounds per dispatch (lax.scan inside the
         # jitted program).  Per-dispatch + per-fetch latency (hundreds of
@@ -281,6 +322,18 @@ class HistGBT:
             # divisor of eval_every ≤ K (gcd alone would collapse to 1
             # for e.g. eval_every=7, paying per-dispatch latency 7×)
             K = max(d for d in range(1, K + 1) if eval_every % d == 0)
+        sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
+        base_key = jax.random.key(p.seed) if sampling else None
+
+        def run(fn, preds_c, done):
+            if sampling:
+                # chunk key derives from the round index so a given round
+                # draws the same sample no matter how rounds are chunked
+                # into dispatches within a fixed K
+                return fn(bins, y_d, w_d, preds_c,
+                          jax.random.fold_in(base_key, done))
+            return fn(bins, y_d, w_d, preds_c)
+
         kfn = self._build_round_fn(F, K)
         rem = p.n_trees % K
         rem_fn = self._build_round_fn(F, rem) if rem else None
@@ -289,24 +342,56 @@ class HistGBT:
             # valid and model state is untouched (preds is donated).
             # np.asarray (not block_until_ready): on remote-tunnel devices
             # only a real data fetch proves execution finished
-            warm = kfn(bins, y_d, w_d, jnp.copy(preds))
+            warm = run(kfn, jnp.copy(preds), 0)
             np.asarray(warm[0][:1])
             if rem_fn is not None:
-                warm = rem_fn(bins, y_d, w_d, jnp.copy(preds))
+                warm = run(rem_fn, jnp.copy(preds), 0)
                 np.asarray(warm[0][:1])
         np.asarray(preds[:1])
+
+        # validation state (binned once; margins updated incrementally)
+        eval_bins = eval_margin = yv_d = None
+        if eval_set is not None:
+            Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
+            yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
+            eval_bins = apply_bins(jnp.asarray(Xv), self.cuts)
+            eval_margin = jnp.full(len(yv), p.base_score, jnp.float32)
+            if continuing:
+                stacked = self._stacked_trees(self.trees)
+                eval_margin = _predict_trees(
+                    eval_bins, stacked["feat"], stacked["thr"],
+                    stacked["leaf"], p.max_depth, p.base_score)
+            yv_d = jnp.asarray(yv)
+        self.best_iteration: Optional[int] = None
+        self.best_score: Optional[float] = None
+        self._early_stopped = bool(early_stopping_rounds)
+        best_at = 0
 
         t0 = get_time()
         chunks: List[Any] = []
         done = 0
         while done < p.n_trees:
             fn = kfn if p.n_trees - done >= K else rem_fn
-            preds, trees_k = fn(bins, y_d, w_d, preds)
+            preds, trees_k = run(fn, preds, done)
             chunks.append(trees_k)        # stacked [k, ...] device arrays
             done += K if fn is kfn else rem
             if eval_every and done % eval_every == 0:
                 loss = float(self._obj.metric(preds, y_d))
                 LOG("INFO", "round %d: %s=%.5f", done, "loss", loss)
+            if eval_bins is not None:
+                eval_margin = _predict_trees(
+                    eval_bins, trees_k["feat"], trees_k["thr"],
+                    trees_k["leaf"], p.max_depth, 0.0, eval_margin)
+                vloss = float(self._obj.metric(eval_margin, yv_d))
+                if self.best_score is None or vloss < self.best_score:
+                    self.best_score = vloss
+                    self.best_iteration = done - 1
+                    best_at = done
+                elif (early_stopping_rounds
+                      and done - best_at >= early_stopping_rounds):
+                    LOG("INFO", "early stop at round %d (best %.5f @ %d)",
+                        done, self.best_score, best_at)
+                    break
         for trees_k in chunks:            # ONE host fetch per chunk
             t_np = jax.tree.map(np.asarray, trees_k)
             k = t_np["leaf"].shape[0]
@@ -401,12 +486,27 @@ class HistGBT:
         obj = self._obj
         t0 = get_time()
         for r in range(p.n_trees):
+            # per-round sampling, same semantics as fit(): rows drawn per
+            # worker (rank-salted), feature mask identical across workers
+            feat_mask = None
+            if p.colsample_bytree < 1.0:
+                crng = np.random.default_rng([p.seed, r, 1])
+                n_keep = max(1, int(np.ceil(p.colsample_bytree * F)))
+                scores = crng.random(F)
+                feat_mask = jnp.asarray(
+                    scores <= np.sort(scores)[n_keep - 1])
+            rrng = (np.random.default_rng([p.seed, r, 2, coll.rank()])
+                    if p.subsample < 1.0 else None)
             # grad/hess per page for this round
             for pg in pages:
                 g, h = obj.grad_hess(jnp.asarray(pg["preds"]),
                                      jnp.asarray(pg["y"]))
                 pg["g"] = np.asarray(g) * pg["w"]
                 pg["h"] = np.asarray(h) * pg["w"]
+                if rrng is not None:
+                    keep = rrng.random(len(pg["y"])) < p.subsample
+                    pg["g"] = np.where(keep, pg["g"], 0.0)
+                    pg["h"] = np.where(keep, pg["h"], 0.0)
                 pg["node"] = np.zeros(len(pg["y"]), np.int32)
             feats, thrs = [], []
             for level in range(depth):
@@ -421,7 +521,7 @@ class HistGBT:
                 hist_np = np.asarray(hist)
                 if distributed:
                     hist_np = coll.allreduce(hist_np)  # cross-worker sync
-                feat, thr = best_split(jnp.asarray(hist_np))
+                feat, thr = best_split(jnp.asarray(hist_np), feat_mask)
                 feats.append(np.pad(np.asarray(feat), (0, half - n_nodes)))
                 thrs.append(np.pad(np.asarray(thr), (0, half - n_nodes)))
                 for pg in pages:
@@ -478,6 +578,7 @@ class HistGBT:
         best_split = _make_best_split(B, lam, gamma, mcw)
         best_split_leaf = _make_best_split(B, lam, gamma, mcw,
                                            with_child_sums=True)
+        sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
 
         def table_select(table, node, n_entries):
             """Gather-free ``table[node]`` for a tiny per-node table: a
@@ -488,10 +589,31 @@ class HistGBT:
             oh = (node[:, None] == n_iota)
             return jnp.sum(jnp.where(oh, table[None, :], 0), axis=1)
 
-        def round_body(bins_l, y_l, w_l, preds_l):
+        def round_body(bins_l, y_l, w_l, preds_l, key=None):
             g, h = obj.grad_hess(preds_l, y_l)
             g = g * w_l
             h = h * w_l
+            feat_mask = None
+            if sampling:
+                key_rows, key_cols = jax.random.split(key)
+                if p.subsample < 1.0:
+                    # decorrelate row draws across shards; the tree built
+                    # this round sees only the subsample (XGBoost
+                    # semantics: leaf values come from the subsample too)
+                    key_rows = jax.random.fold_in(
+                        key_rows, jax.lax.axis_index("data"))
+                    keep = (jax.random.uniform(key_rows, g.shape)
+                            < p.subsample)
+                    g = jnp.where(keep, g, 0.0)
+                    h = jnp.where(keep, h, 0.0)
+                if p.colsample_bytree < 1.0:
+                    # same mask on every shard (key NOT folded); exact
+                    # count like XGBoost: keep the ⌈c·F⌉ smallest scores
+                    n_keep = max(1, int(np.ceil(
+                        p.colsample_bytree * n_features)))
+                    scores = jax.random.uniform(key_cols, (n_features,))
+                    kth = jnp.sort(scores)[n_keep - 1]
+                    feat_mask = scores <= kth
             node = jnp.zeros(bins_l.shape[0], jnp.int32)
             feats = []
             thrs = []
@@ -504,9 +626,9 @@ class HistGBT:
                     # deepest level: the histogram cumsum at the chosen
                     # threshold already IS the leaf g/h sums — no extra
                     # pass over the rows needed
-                    feat, thr, gsum, hsum = best_split_leaf(hist)
+                    feat, thr, gsum, hsum = best_split_leaf(hist, feat_mask)
                 else:
-                    feat, thr = best_split(hist)
+                    feat, thr = best_split(hist, feat_mask)
                 # pad per-level arrays to a common width for stacking
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
@@ -530,16 +652,33 @@ class HistGBT:
             }
             return preds_new, tree
 
-        def k_rounds_body(bins_l, y_l, w_l, preds_l):
-            def step(preds_c, _):
-                return round_body(bins_l, y_l, w_l, preds_c)
+        if sampling:
+            def k_rounds_body(bins_l, y_l, w_l, preds_l, key):
+                def step(carry, _):
+                    preds_c, key_c = carry
+                    key_c, key_r = jax.random.split(key_c)
+                    preds2, tree = round_body(bins_l, y_l, w_l, preds_c,
+                                              key_r)
+                    return (preds2, key_c), tree
 
-            return jax.lax.scan(step, preds_l, None, length=n_rounds)
+                (preds_out, _), trees = jax.lax.scan(
+                    step, (preds_l, key), None, length=n_rounds)
+                return preds_out, trees
+
+            in_specs = (P("data", None), P("data"), P("data"), P("data"), P())
+        else:
+            def k_rounds_body(bins_l, y_l, w_l, preds_l):
+                def step(preds_c, _):
+                    return round_body(bins_l, y_l, w_l, preds_c)
+
+                return jax.lax.scan(step, preds_l, None, length=n_rounds)
+
+            in_specs = (P("data", None), P("data"), P("data"), P("data"))
 
         mapped = shard_map(
             k_rounds_body,
             mesh=self.mesh,
-            in_specs=(P("data", None), P("data"), P("data"), P("data")),
+            in_specs=in_specs,
             out_specs=(P("data"), P()),
             check_vma=False,
         )
@@ -556,12 +695,11 @@ class HistGBT:
         p = self.param
         X = np.ascontiguousarray(X, dtype=np.float32)
         bins = apply_bins(jnp.asarray(X), self.cuts)
+        if n_trees is None and getattr(self, "_early_stopped", False) \
+                and self.best_iteration is not None:
+            n_trees = self.best_iteration + 1   # XGBoost early-stop default
         use = self.trees if n_trees is None else self.trees[:n_trees]
-        stacked = {
-            "feat": jnp.asarray(np.stack([t["feat"] for t in use])),   # [T, D, half]
-            "thr": jnp.asarray(np.stack([t["thr"] for t in use])),
-            "leaf": jnp.asarray(np.stack([t["leaf"] for t in use])),   # [T, n_leaf]
-        }
+        stacked = self._stacked_trees(use)
         margin = _predict_trees(bins, stacked["feat"], stacked["thr"],
                                 stacked["leaf"], p.max_depth, p.base_score)
         if output_margin:
@@ -573,10 +711,102 @@ class HistGBT:
         CHECK(hasattr(self, "_train_preds"), "call fit first")
         return np.asarray(self._train_preds)[: self._n_real_rows]
 
+    @staticmethod
+    def _stacked_trees(trees: List[Dict[str, np.ndarray]]) -> Dict[str, jax.Array]:
+        return {k: jnp.asarray(np.stack([t[k] for t in trees]))
+                for k in ("feat", "thr", "leaf")}
+
+    # ------------------------------------------------------------------
+    # persistence & introspection
+    # ------------------------------------------------------------------
+    _MODEL_MAGIC = b"DCTGBT01"
+
+    def save_model(self, uri: str) -> None:
+        """Serialize params + bin cuts + trees to any Stream URI
+        (local/S3/GCS/WebHDFS/Azure — the reference's Booster::Save over
+        ``dmlc::Stream`` checkpoint layering, SURVEY.md §5)."""
+        from dmlc_core_tpu.io.serializer import write_obj
+        from dmlc_core_tpu.io.stream import Stream
+
+        CHECK(self.cuts is not None and len(self.trees) > 0,
+              "save_model before fit")
+        s = Stream.create(uri, "w")
+        try:
+            s.write(self._MODEL_MAGIC)
+            write_obj(s, {
+                "param": self.param.to_dict(),
+                "cuts": np.asarray(self.cuts),
+                "trees": self.trees,
+                # early-stopping state must survive the round trip or a
+                # reloaded model would silently predict with the overfit
+                # post-best tail
+                "best_iteration": self.best_iteration,
+                "best_score": self.best_score,
+                "early_stopped": getattr(self, "_early_stopped", False),
+            })
+        finally:
+            s.close()
+
+    @classmethod
+    def load_model(cls, uri: str, mesh: Optional[Mesh] = None) -> "HistGBT":
+        """Inverse of :meth:`save_model`; the loaded model predicts
+        immediately (honoring a saved early-stop best_iteration) and
+        continues training via :meth:`fit` — continued fits reuse the
+        saved bin cuts and start from the ensemble's margins."""
+        from dmlc_core_tpu.io.serializer import read_obj
+        from dmlc_core_tpu.io.stream import Stream
+
+        s = Stream.create(uri, "r")
+        try:
+            magic = s.read(len(cls._MODEL_MAGIC))
+            CHECK_EQ(bytes(magic), cls._MODEL_MAGIC,
+                     f"not a HistGBT model: {uri}")
+            payload = read_obj(s)
+        finally:
+            s.close()
+        model = cls(mesh=mesh)
+        model.param.init(payload["param"])
+        model._obj = OBJECTIVES[model.param.objective]
+        model.cuts = jnp.asarray(payload["cuts"])
+        model.trees = [dict(t) for t in payload["trees"]]
+        model.best_iteration = payload.get("best_iteration")
+        model.best_score = payload.get("best_score")
+        model._early_stopped = payload.get("early_stopped", False)
+        return model
+
+    def feature_importances(self, importance_type: str = "weight"
+                            ) -> np.ndarray:
+        """Per-feature importance over the ensemble.
+
+        ``"weight"``: number of real (non-degenerate, non-padding) splits
+        using each feature.  Degenerate/early-stopped nodes are written
+        with ``thr == n_bins-1`` and level padding with ``thr == 0`` past
+        the level's node count, so only genuine splits are counted.
+        """
+        CHECK(len(self.trees) > 0, "no trees trained")
+        if importance_type != "weight":
+            log_fatal(f"unsupported importance_type {importance_type!r}")
+        F = int(np.asarray(self.cuts).shape[0])
+        counts = np.zeros(F, np.int64)
+        B = self.param.n_bins
+        for tree in self.trees:
+            for level in range(tree["feat"].shape[0]):
+                n_nodes = 1 << level
+                feat = np.asarray(tree["feat"][level][:n_nodes])
+                thr = np.asarray(tree["thr"][level][:n_nodes])
+                real = thr < B - 1          # degenerate splits use B-1
+                np.add.at(counts, feat[real], 1)
+        return counts
+
 
 @partial(jax.jit, static_argnums=(4,))
-def _predict_trees(bins, feats, thrs, leaves, depth: int, base_score: float):
-    """Sum leaf values over trees: scan over trees, unrolled descent."""
+def _predict_trees(bins, feats, thrs, leaves, depth: int,
+                   base_score: float = 0.0, init=None):
+    """Sum leaf values over trees: scan over trees, unrolled descent.
+
+    ``init`` carries margins from already-applied trees (the incremental
+    validation path); otherwise margins start at ``base_score``.
+    """
 
     def one_tree(carry, tree):
         feat, thr, leaf = tree
@@ -588,6 +818,7 @@ def _predict_trees(bins, feats, thrs, leaves, depth: int, base_score: float):
             node = 2 * node + (row_bin > t).astype(jnp.int32)
         return carry + leaf[node], None
 
-    init = jnp.full(bins.shape[0], base_score, jnp.float32)
+    if init is None:
+        init = jnp.full(bins.shape[0], base_score, jnp.float32)
     total, _ = jax.lax.scan(one_tree, init, (feats, thrs, leaves))
     return total

@@ -201,3 +201,162 @@ class TestHistGBT:
             HistGBT(max_depth=50)
         with pytest.raises(Error):
             HistGBT(objective="multi:softmax")
+
+
+class TestGBTExtras:
+    def _data(self, n=6000, F=6, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, F)).astype(np.float32)
+        y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
+        return X, y
+
+    def test_save_load_round_trip(self, tmp_path):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data()
+        m = HistGBT(n_trees=8, max_depth=3, n_bins=32)
+        m.fit(X, y)
+        uri = str(tmp_path / "model.bin")
+        m.save_model(uri)
+        m2 = HistGBT.load_model(uri)
+        np.testing.assert_array_equal(m2.predict(X, output_margin=True),
+                                      m.predict(X, output_margin=True))
+        assert m2.param.n_trees == 8 and m2.param.max_depth == 3
+
+    def test_load_rejects_garbage(self, tmp_path):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models import HistGBT
+
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(b"NOTAMODELxxxx")
+        with pytest.raises(Error):
+            HistGBT.load_model(str(bad))
+
+    def test_subsample_colsample_train(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data()
+        m = HistGBT(n_trees=25, max_depth=4, n_bins=32,
+                    subsample=0.7, colsample_bytree=0.7, seed=3,
+                    learning_rate=0.3)
+        m.fit(X, y)
+        acc = ((m.predict(X) > 0.5) == y).mean()
+        assert acc > 0.85, acc
+        # same seed → identical model
+        m2 = HistGBT(n_trees=25, max_depth=4, n_bins=32,
+                     subsample=0.7, colsample_bytree=0.7, seed=3,
+                     learning_rate=0.3)
+        m2.fit(X, y)
+        np.testing.assert_array_equal(m.predict(X, output_margin=True),
+                                      m2.predict(X, output_margin=True))
+
+    def test_colsample_restricts_features(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(F=8)
+        m = HistGBT(n_trees=10, max_depth=3, n_bins=32,
+                    colsample_bytree=0.25, seed=1)
+        m.fit(X, y)
+        # ⌈0.25·8⌉ = 2 features available per tree → per-tree split
+        # features must come from ≤2 distinct features
+        B = m.param.n_bins
+        for tree in m.trees:
+            used = set()
+            for level in range(tree["feat"].shape[0]):
+                n_nodes = 1 << level
+                feat = tree["feat"][level][:n_nodes]
+                thr = tree["thr"][level][:n_nodes]
+                used.update(feat[thr < B - 1].tolist())
+            assert len(used) <= 2, used
+
+    def test_early_stopping(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=4000)
+        Xv, yv = self._data(n=2000, seed=9)
+        m = HistGBT(n_trees=200, max_depth=3, n_bins=32, learning_rate=0.5)
+        m.fit(X, y, eval_set=(Xv, yv), early_stopping_rounds=10)
+        assert m.best_iteration is not None and m.best_score is not None
+        assert len(m.trees) < 200            # actually stopped early
+        # default predict uses best_iteration+1 trees
+        pd_best = m.predict(Xv, output_margin=True)
+        pd_explicit = m.predict(Xv, output_margin=True,
+                                n_trees=m.best_iteration + 1)
+        np.testing.assert_array_equal(pd_best, pd_explicit)
+
+    def test_feature_importances(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(F=6)
+        m = HistGBT(n_trees=15, max_depth=3, n_bins=32)
+        m.fit(X, y)
+        imp = m.feature_importances()
+        assert imp.shape == (6,)
+        # informative features (0,1,2) must dominate the noise ones
+        assert imp[:3].sum() > imp[3:].sum()
+
+    def test_continue_training(self, tmp_path):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data()
+        full = HistGBT(n_trees=20, max_depth=3, n_bins=32, learning_rate=0.3)
+        full.fit(X, y)
+
+        half = HistGBT(n_trees=10, max_depth=3, n_bins=32, learning_rate=0.3)
+        half.fit(X, y)
+        uri = str(tmp_path / "half.bin")
+        half.save_model(uri)
+        cont = HistGBT.load_model(uri)
+        cont.param.init({"n_trees": 10})
+        cont.fit(X, y)                       # 10 more rounds on top
+        assert len(cont.trees) == 20
+        np.testing.assert_allclose(
+            cont.predict(X, output_margin=True),
+            full.predict(X, output_margin=True), rtol=1e-4, atol=1e-5)
+
+    def test_early_stop_state_survives_save_load(self, tmp_path):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=4000)
+        Xv, yv = self._data(n=2000, seed=9)
+        m = HistGBT(n_trees=200, max_depth=3, n_bins=32, learning_rate=0.5)
+        m.fit(X, y, eval_set=(Xv, yv), early_stopping_rounds=10)
+        uri = str(tmp_path / "es.bin")
+        m.save_model(uri)
+        m2 = HistGBT.load_model(uri)
+        assert m2.best_iteration == m.best_iteration
+        np.testing.assert_array_equal(m2.predict(Xv, output_margin=True),
+                                      m.predict(Xv, output_margin=True))
+
+    def test_subsample_zero_rejected(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models import HistGBT
+
+        with pytest.raises(Error):
+            HistGBT(subsample=0.0)
+
+    def test_external_memory_sampling(self, tmp_path):
+        from dmlc_core_tpu.data.iter import RowBlockIter
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=2000, F=6)
+        svm = tmp_path / "t.svm"
+        with open(svm, "w") as f:
+            for i in range(len(y)):
+                feats = " ".join(f"{j}:{X[i, j]:.5f}" for j in range(6))
+                f.write(f"{y[i]:.0f} {feats}\n")
+        it = RowBlockIter.create(str(svm), 0, 1, "libsvm")
+        m = HistGBT(n_trees=10, max_depth=3, n_bins=32,
+                    colsample_bytree=0.34, seed=5)
+        m.fit_external(it, num_col=6)
+        B = m.param.n_bins
+        for tree in m.trees:                 # ≤ ⌈0.34·6⌉ = 3 features/tree
+            used = set()
+            for level in range(tree["feat"].shape[0]):
+                n_nodes = 1 << level
+                feat = tree["feat"][level][:n_nodes]
+                thr = tree["thr"][level][:n_nodes]
+                used.update(np.asarray(feat)[np.asarray(thr) < B - 1].tolist())
+            assert len(used) <= 3, used
