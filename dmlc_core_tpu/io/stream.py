@@ -17,6 +17,7 @@ inherit every backend for free.
 from __future__ import annotations
 
 import abc
+import io
 import sys
 from typing import Any, Optional
 
@@ -76,6 +77,17 @@ class Stream(abc.ABC):
     def __exit__(self, *exc: Any) -> None:
         self.close()
 
+    def as_file(self):
+        """A Python file object over this stream.
+
+        Reference parity: ``include/dmlc/io.h :: dmlc::ostream/istream``
+        (streambuf adapters) — lets std-library code that wants a file
+        (pickle, json.dump, np.save, TextIOWrapper…) write through any
+        Stream backend.  Closing the file object does NOT close the
+        underlying stream.
+        """
+        return _StreamFile(self)
+
     # -- URI dispatch ----------------------------------------------------
     @staticmethod
     def create(uri: str, mode: str = "r", allow_null: bool = False) -> Optional["Stream"]:
@@ -125,6 +137,45 @@ class SeekStream(Stream):
     @abc.abstractmethod
     def tell(self) -> int:
         ...
+
+
+class _StreamFile(io.RawIOBase):
+    """io.RawIOBase view of a Stream (see :meth:`Stream.as_file`)."""
+
+    def __init__(self, stream: "Stream"):
+        self._stream = stream
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        data = self._stream.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def write(self, b) -> int:
+        return self._stream.write(bytes(b))
+
+    def flush(self) -> None:
+        self._stream.flush()
+
+    def seekable(self) -> bool:
+        return isinstance(self._stream, SeekStream)
+
+    def seek(self, pos: int, whence: int = 0) -> int:
+        CHECK(isinstance(self._stream, SeekStream),
+              "as_file().seek on a non-seekable Stream")
+        CHECK(whence == 0, "Stream.as_file only supports absolute seeks")
+        self._stream.seek(pos)
+        return pos
+
+    def tell(self) -> int:
+        CHECK(isinstance(self._stream, SeekStream),
+              "as_file().tell on a non-seekable Stream")
+        return self._stream.tell()
 
 
 class _StdStream(Stream):

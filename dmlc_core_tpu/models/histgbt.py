@@ -79,6 +79,43 @@ class _Logistic:
         return m
 
 
+@OBJECTIVES.register("multi:softmax")
+class _Softmax:
+    """K-class softmax objective (XGBoost ``multi:softmax``) — margins are
+    [n, K]; grad/hess per class from the full softmax row.  ``predict``
+    returns argmax classes (``multi:softprob`` = same training, transform
+    returns the probability matrix)."""
+
+    @staticmethod
+    def grad_hess(pred, y):                  # pred [n,K], y [n] labels
+        K = pred.shape[1]
+        prob = jax.nn.softmax(pred, axis=1)
+        yoh = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=pred.dtype)
+        return prob - yoh, jnp.maximum(2.0 * prob * (1.0 - prob), 1e-6)
+
+    @staticmethod
+    def transform(pred):                     # class index
+        return jnp.argmax(pred, axis=1).astype(jnp.float32)
+
+    @staticmethod
+    def prob(pred):
+        return jax.nn.softmax(pred, axis=1)
+
+    @staticmethod
+    def row_loss(pred, y):                   # mlogloss
+        logp = jax.nn.log_softmax(pred, axis=1)
+        return -jnp.take_along_axis(
+            logp, y.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+    @staticmethod
+    def metric(pred, y):
+        return jnp.mean(_Softmax.row_loss(pred, y))
+
+    @staticmethod
+    def finalize_mean_loss(m: float) -> float:
+        return m
+
+
 @OBJECTIVES.register("reg:squarederror")
 class _SquaredError:
     @staticmethod
@@ -197,7 +234,10 @@ class HistGBTParam(Parameter):
     gamma = field(float, default=0.0, lower_bound=0.0, description="min split gain")
     min_child_weight = field(float, default=1.0, lower_bound=0.0)
     objective = field(str, default="binary:logistic",
-                      enum=["binary:logistic", "reg:squarederror"])
+                      enum=["binary:logistic", "reg:squarederror",
+                            "multi:softmax"])
+    num_class = field(int, default=1, lower_bound=1,
+                      description="classes for multi:softmax")
     base_score = field(float, default=0.0, description="initial raw margin")
     subsample = field(float, default=1.0, lower_bound=0.0, upper_bound=1.0,
                       description="per-round row subsampling rate")
@@ -232,6 +272,13 @@ class HistGBT:
         CHECK(self.param.subsample > 0.0, "subsample must be in (0, 1]")
         CHECK(self.param.colsample_bytree > 0.0,
               "colsample_bytree must be in (0, 1]")
+        if self.param.objective == "multi:softmax":
+            CHECK(self.param.num_class >= 2,
+                  "multi:softmax needs num_class >= 2")
+        else:
+            CHECK(self.param.num_class == 1,
+                  f"num_class > 1 requires multi:softmax, "
+                  f"got {self.param.objective!r}")
         self._obj = OBJECTIVES[self.param.objective]
         self.cuts: Optional[jax.Array] = None          # [F, n_bins-1]
         self.trees: List[Dict[str, np.ndarray]] = []   # per-tree arrays
@@ -277,10 +324,15 @@ class HistGBT:
             CHECK(eval_set is not None,
                   "early_stopping_rounds needs an eval_set")
 
+        if p.num_class > 1:
+            CHECK(y.min() >= 0 and y.max() < p.num_class,
+                  f"multi:softmax labels must be in [0, {p.num_class})")
+
         # continued training (xgb_model semantics): keep the existing bin
         # boundaries — the loaded trees' thresholds are only meaningful
         # against them — and start margins from the existing ensemble
         continuing = len(self.trees) > 0
+        n_prior = len(self.trees)      # best_iteration indexes the FULL list
         if continuing:
             CHECK(self.cuts is not None, "continue-fit without cuts")
         else:
@@ -303,13 +355,17 @@ class HistGBT:
         bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
         y_d = jax.device_put(y, row_sharding)
         w_d = jax.device_put(mask, row_sharding)
-        init_margin = np.full(n + n_pad, p.base_score, np.float32)
+        K_cls = p.num_class
+        margin_shape = (n + n_pad, K_cls) if K_cls > 1 else (n + n_pad,)
+        init_margin = np.full(margin_shape, p.base_score, np.float32)
         if continuing:
-            stacked = self._stacked_trees(self.trees)
-            init_margin = np.asarray(_predict_trees(
-                bins, stacked["feat"], stacked["thr"], stacked["leaf"],
-                p.max_depth, p.base_score)).astype(np.float32)
-        preds = jax.device_put(init_margin, row_sharding)
+            init_margin = np.asarray(self._apply_trees(
+                bins, self._stacked_trees(self.trees),
+                jnp.full(margin_shape, p.base_score, jnp.float32))
+            ).astype(np.float32)
+        preds = jax.device_put(
+            init_margin,
+            mat_sharding if K_cls > 1 else row_sharding)
 
         # chunk rounds: K boosting rounds per dispatch (lax.scan inside the
         # jitted program).  Per-dispatch + per-fetch latency (hundreds of
@@ -355,12 +411,11 @@ class HistGBT:
             Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
             yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
             eval_bins = apply_bins(jnp.asarray(Xv), self.cuts)
-            eval_margin = jnp.full(len(yv), p.base_score, jnp.float32)
+            ev_shape = (len(yv), K_cls) if K_cls > 1 else (len(yv),)
+            eval_margin = jnp.full(ev_shape, p.base_score, jnp.float32)
             if continuing:
-                stacked = self._stacked_trees(self.trees)
-                eval_margin = _predict_trees(
-                    eval_bins, stacked["feat"], stacked["thr"],
-                    stacked["leaf"], p.max_depth, p.base_score)
+                eval_margin = self._apply_trees(
+                    eval_bins, self._stacked_trees(self.trees), eval_margin)
             yv_d = jnp.asarray(yv)
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
@@ -379,13 +434,12 @@ class HistGBT:
                 loss = float(self._obj.metric(preds, y_d))
                 LOG("INFO", "round %d: %s=%.5f", done, "loss", loss)
             if eval_bins is not None:
-                eval_margin = _predict_trees(
-                    eval_bins, trees_k["feat"], trees_k["thr"],
-                    trees_k["leaf"], p.max_depth, 0.0, eval_margin)
+                eval_margin = self._apply_trees(eval_bins, trees_k,
+                                                eval_margin)
                 vloss = float(self._obj.metric(eval_margin, yv_d))
                 if self.best_score is None or vloss < self.best_score:
                     self.best_score = vloss
-                    self.best_iteration = done - 1
+                    self.best_iteration = n_prior + done - 1
                     best_at = done
                 elif (early_stopping_rounds
                       and done - best_at >= early_stopping_rounds):
@@ -440,6 +494,8 @@ class HistGBT:
         from dmlc_core_tpu.parallel import collectives as coll
 
         p = self.param
+        CHECK(p.num_class == 1,
+              "fit_external: multi:softmax not supported yet — use fit()")
         B = p.n_bins
         depth = p.max_depth
         n_leaf = 1 << depth
@@ -589,31 +645,33 @@ class HistGBT:
             oh = (node[:, None] == n_iota)
             return jnp.sum(jnp.where(oh, table[None, :], 0), axis=1)
 
-        def round_body(bins_l, y_l, w_l, preds_l, key=None):
-            g, h = obj.grad_hess(preds_l, y_l)
-            g = g * w_l
-            h = h * w_l
-            feat_mask = None
-            if sampling:
-                key_rows, key_cols = jax.random.split(key)
-                if p.subsample < 1.0:
-                    # decorrelate row draws across shards; the tree built
-                    # this round sees only the subsample (XGBoost
-                    # semantics: leaf values come from the subsample too)
-                    key_rows = jax.random.fold_in(
-                        key_rows, jax.lax.axis_index("data"))
-                    keep = (jax.random.uniform(key_rows, g.shape)
-                            < p.subsample)
-                    g = jnp.where(keep, g, 0.0)
-                    h = jnp.where(keep, h, 0.0)
-                if p.colsample_bytree < 1.0:
-                    # same mask on every shard (key NOT folded); exact
-                    # count like XGBoost: keep the ⌈c·F⌉ smallest scores
-                    n_keep = max(1, int(np.ceil(
-                        p.colsample_bytree * n_features)))
-                    scores = jax.random.uniform(key_cols, (n_features,))
-                    kth = jnp.sort(scores)[n_keep - 1]
-                    feat_mask = scores <= kth
+        def sample_masks(key, row_shape):
+            """(row keep mask | None, feature mask | None) for one round."""
+            keep = feat_mask = None
+            key_rows, key_cols = jax.random.split(key)
+            if p.subsample < 1.0:
+                # decorrelate row draws across shards; the tree built
+                # this round sees only the subsample (XGBoost
+                # semantics: leaf values come from the subsample too)
+                key_rows = jax.random.fold_in(
+                    key_rows, jax.lax.axis_index("data"))
+                keep = jax.random.uniform(key_rows, row_shape) < p.subsample
+            if p.colsample_bytree < 1.0:
+                # same mask on every shard (key NOT folded); exact
+                # count like XGBoost: keep the ⌈c·F⌉ smallest scores
+                n_keep = max(1, int(np.ceil(
+                    p.colsample_bytree * n_features)))
+                scores = jax.random.uniform(key_cols, (n_features,))
+                kth = jnp.sort(scores)[n_keep - 1]
+                feat_mask = scores <= kth
+            return keep, feat_mask
+
+        def grow_tree(bins_l, g, h, feat_mask):
+            """One level-wise tree on (g, h) → (tree arrays, margin delta).
+
+            The per-level histogram is psum'd over the data axis (THE
+            histogram-sync allreduce); leaf g/h sums come free from the
+            deepest level's cumsum."""
             node = jnp.zeros(bins_l.shape[0], jnp.int32)
             feats = []
             thrs = []
@@ -621,11 +679,8 @@ class HistGBT:
             for level in range(depth):
                 n_nodes = 1 << level
                 hist = build_histogram(bins_l, node, g, h, n_nodes, B, method)
-                hist = jax.lax.psum(hist, "data")        # ← THE histogram sync
+                hist = jax.lax.psum(hist, "data")
                 if level == depth - 1:
-                    # deepest level: the histogram cumsum at the chosen
-                    # threshold already IS the leaf g/h sums — no extra
-                    # pass over the rows needed
                     feat, thr, gsum, hsum = best_split_leaf(hist, feat_mask)
                 else:
                     feat, thr = best_split(hist, feat_mask)
@@ -641,17 +696,49 @@ class HistGBT:
                     jnp.where(feat_sel[:, None] == f_iota,
                               bins_l.astype(jnp.int32), 0), axis=1)   # [n]
                 node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
-            # gsum/hsum came from the (already psum'd) deepest histogram,
-            # so they are global — no further collective needed
             leaf = -gsum / (hsum + lam) * eta
-            preds_new = preds_l + table_select(leaf, node, n_leaf)
             tree = {
                 "feat": jnp.stack(feats),                # [depth, half]
                 "thr": jnp.stack(thrs),
                 "leaf": leaf,                            # [n_leaf]
             }
-            return preds_new, tree
+            return tree, table_select(leaf, node, n_leaf)
 
+        n_class = p.num_class
+
+        def round_body(bins_l, y_l, w_l, preds_l, key=None):
+            keep = feat_mask = None
+            if sampling:
+                keep, feat_mask = sample_masks(key, y_l.shape)
+            if n_class <= 1:
+                g, h = obj.grad_hess(preds_l, y_l)
+                g = g * w_l
+                h = h * w_l
+                if keep is not None:
+                    g = jnp.where(keep, g, 0.0)
+                    h = jnp.where(keep, h, 0.0)
+                tree, delta = grow_tree(bins_l, g, h, feat_mask)
+                return preds_l + delta, tree
+            # multiclass: preds_l [n, K]; one tree per class per round,
+            # built on the full-softmax gradients (XGBoost multi:softmax)
+            g_all, h_all = obj.grad_hess(preds_l, y_l)    # [n, K]
+            g_all = g_all * w_l[:, None]
+            h_all = h_all * w_l[:, None]
+            if keep is not None:                          # same rows ∀ class
+                g_all = jnp.where(keep[:, None], g_all, 0.0)
+                h_all = jnp.where(keep[:, None], h_all, 0.0)
+            class_trees = []
+            deltas = []
+            for c in range(n_class):
+                tree_c, delta_c = grow_tree(
+                    bins_l, g_all[:, c], h_all[:, c], feat_mask)
+                class_trees.append(tree_c)
+                deltas.append(delta_c)
+            tree = {key_: jnp.stack([t[key_] for t in class_trees])
+                    for key_ in ("feat", "thr", "leaf")}  # [K, ...]
+            return preds_l + jnp.stack(deltas, axis=1), tree
+
+        preds_spec = P("data", None) if n_class > 1 else P("data")
         if sampling:
             def k_rounds_body(bins_l, y_l, w_l, preds_l, key):
                 def step(carry, _):
@@ -665,7 +752,8 @@ class HistGBT:
                     step, (preds_l, key), None, length=n_rounds)
                 return preds_out, trees
 
-            in_specs = (P("data", None), P("data"), P("data"), P("data"), P())
+            in_specs = (P("data", None), P("data"), P("data"), preds_spec,
+                        P())
         else:
             def k_rounds_body(bins_l, y_l, w_l, preds_l):
                 def step(preds_c, _):
@@ -673,13 +761,13 @@ class HistGBT:
 
                 return jax.lax.scan(step, preds_l, None, length=n_rounds)
 
-            in_specs = (P("data", None), P("data"), P("data"), P("data"))
+            in_specs = (P("data", None), P("data"), P("data"), preds_spec)
 
         mapped = shard_map(
             k_rounds_body,
             mesh=self.mesh,
             in_specs=in_specs,
-            out_specs=(P("data"), P()),
+            out_specs=(preds_spec, P()),
             check_vma=False,
         )
         self._round_fn = jax.jit(mapped, donate_argnums=(3,))
@@ -700,11 +788,27 @@ class HistGBT:
             n_trees = self.best_iteration + 1   # XGBoost early-stop default
         use = self.trees if n_trees is None else self.trees[:n_trees]
         stacked = self._stacked_trees(use)
-        margin = _predict_trees(bins, stacked["feat"], stacked["thr"],
-                                stacked["leaf"], p.max_depth, p.base_score)
+        shape = ((bins.shape[0], p.num_class) if p.num_class > 1
+                 else (bins.shape[0],))
+        margin = self._apply_trees(
+            bins, stacked, jnp.full(shape, p.base_score, jnp.float32))
         if output_margin:
             return np.asarray(margin)
         return np.asarray(self._obj.transform(margin))
+
+    def predict_proba(self, X: np.ndarray,
+                      n_trees: Optional[int] = None) -> np.ndarray:
+        """Class probability matrix [n, K] (``multi:softprob`` semantics);
+        for the binary objective, [n, 2] columns (1-p, p)."""
+        p = self.param
+        CHECK(p.objective in ("binary:logistic", "multi:softmax"),
+              f"predict_proba needs a classification objective, "
+              f"got {p.objective!r}")
+        margin = self.predict(X, output_margin=True, n_trees=n_trees)
+        if p.num_class > 1:
+            return np.asarray(self._obj.prob(jnp.asarray(margin)))
+        prob1 = np.asarray(self._obj.transform(jnp.asarray(margin)))
+        return np.stack([1.0 - prob1, prob1], axis=1)
 
     def train_margins(self) -> np.ndarray:
         """Raw training-set margins after fit (real rows only)."""
@@ -715,6 +819,21 @@ class HistGBT:
     def _stacked_trees(trees: List[Dict[str, np.ndarray]]) -> Dict[str, jax.Array]:
         return {k: jnp.asarray(np.stack([t[k] for t in trees]))
                 for k in ("feat", "thr", "leaf")}
+
+    def _apply_trees(self, bins, stacked, init):
+        """Add the stacked trees' margins onto ``init`` ([n] or [n, K])."""
+        depth = self.param.max_depth
+        if stacked["feat"].ndim == 4:      # multiclass: [T, K, depth, half]
+            cols = [
+                _predict_trees(bins, stacked["feat"][:, c],
+                               stacked["thr"][:, c],
+                               stacked["leaf"][:, c], depth, 0.0,
+                               init[:, c])
+                for c in range(stacked["feat"].shape[1])
+            ]
+            return jnp.stack(cols, axis=1)
+        return _predict_trees(bins, stacked["feat"], stacked["thr"],
+                              stacked["leaf"], depth, 0.0, init)
 
     # ------------------------------------------------------------------
     # persistence & introspection
@@ -790,12 +909,17 @@ class HistGBT:
         counts = np.zeros(F, np.int64)
         B = self.param.n_bins
         for tree in self.trees:
-            for level in range(tree["feat"].shape[0]):
-                n_nodes = 1 << level
-                feat = np.asarray(tree["feat"][level][:n_nodes])
-                thr = np.asarray(tree["thr"][level][:n_nodes])
-                real = thr < B - 1          # degenerate splits use B-1
-                np.add.at(counts, feat[real], 1)
+            feat_t = np.asarray(tree["feat"])
+            thr_t = np.asarray(tree["thr"])
+            if feat_t.ndim == 2:            # single-output: [depth, half]
+                feat_t, thr_t = feat_t[None], thr_t[None]
+            for feat_c, thr_c in zip(feat_t, thr_t):   # per class tree
+                for level in range(feat_c.shape[0]):
+                    n_nodes = 1 << level
+                    feat = feat_c[level][:n_nodes]
+                    thr = thr_c[level][:n_nodes]
+                    real = thr < B - 1      # degenerate splits use B-1
+                    np.add.at(counts, feat[real], 1)
         return counts
 
 

@@ -3,6 +3,7 @@ threaded iterator, input splits.  Mirrors the reference's unittest_serializer
 / unittest_json / unittest_threaditer(_exc_handling) / unittest_inputsplit
 coverage (SURVEY.md §4)."""
 
+import io
 import os
 import struct
 
@@ -580,3 +581,31 @@ class TestShuffleAndCache:
             assert pass2 == lines
             assert os.path.exists(cache)
             split.close()
+
+
+class TestStreamAsFile:
+    def test_pickle_through_stream(self, tmp_path):
+        import pickle
+        from dmlc_core_tpu.io.stream import Stream
+
+        path = str(tmp_path / "obj.pkl")
+        obj = {"a": [1, 2, 3], "b": "hello"}
+        with Stream.create(path, "w") as s:
+            pickle.dump(obj, s.as_file())
+        with Stream.create(path, "r") as s:
+            back = pickle.load(io.BufferedReader(s.as_file()))
+        assert back == obj
+
+    def test_text_wrapper_and_seek(self):
+        import io as _io
+        from dmlc_core_tpu.io.memory_io import MemoryStringStream
+
+        buf = MemoryStringStream()
+        f = _io.TextIOWrapper(buf.as_file(), encoding="utf-8")
+        f.write("line1\nline2\n")
+        f.flush()
+        rd = MemoryStringStream(buf.data)
+        ff = rd.as_file()
+        assert ff.seekable()
+        data = bytes(rd.read_all())
+        assert data == b"line1\nline2\n"

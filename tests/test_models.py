@@ -360,3 +360,131 @@ class TestGBTExtras:
                 thr = tree["thr"][level][:n_nodes]
                 used.update(np.asarray(feat)[np.asarray(thr) < B - 1].tolist())
             assert len(used) <= 3, used
+
+
+class TestMulticlass:
+    def _data(self, n=6000, F=6, K=3, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, F)).astype(np.float32)
+        # separable blobs along features 0/1 — centers FIXED across calls
+        # so train/validation draws come from the same distribution
+        centers = np.random.default_rng(42).normal(scale=3.0, size=(K, 2))
+        y = rng.integers(0, K, n)
+        X[:, :2] += centers[y]
+        return X, y.astype(np.float32)
+
+    def test_train_predict(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data()
+        m = HistGBT(n_trees=15, max_depth=4, n_bins=32,
+                    objective="multi:softmax", num_class=3,
+                    learning_rate=0.5)
+        m.fit(X, y)
+        pred = m.predict(X)
+        assert pred.shape == (len(y),)
+        acc = (pred == y).mean()
+        assert acc > 0.9, acc
+        proba = m.predict_proba(X)
+        assert proba.shape == (len(y), 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+        assert (proba.argmax(1) == pred).all()
+
+    def test_save_load_and_continue(self, tmp_path):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=3000)
+        m = HistGBT(n_trees=6, max_depth=3, n_bins=32,
+                    objective="multi:softmax", num_class=3)
+        m.fit(X, y)
+        uri = str(tmp_path / "mc.bin")
+        m.save_model(uri)
+        m2 = HistGBT.load_model(uri)
+        np.testing.assert_array_equal(m2.predict(X), m.predict(X))
+        m2.param.init({"n_trees": 4})
+        m2.fit(X, y)                         # continue training
+        assert len(m2.trees) == 10
+        acc = (m2.predict(X) == y).mean()
+        assert acc > 0.85, acc
+
+    def test_early_stopping_multiclass(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=3000)
+        Xv, yv = self._data(n=1500, seed=5)
+        m = HistGBT(n_trees=100, max_depth=3, n_bins=32,
+                    objective="multi:softmax", num_class=3,
+                    learning_rate=0.5)
+        m.fit(X, y, eval_set=(Xv, yv), early_stopping_rounds=10)
+        assert m.best_iteration is not None
+
+    def test_num_class_objective_consistency(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models import HistGBT
+
+        with pytest.raises(Error):
+            HistGBT(objective="multi:softmax")           # num_class missing
+        with pytest.raises(Error):
+            HistGBT(num_class=3)                         # objective not multi
+
+    def test_sharded_equals_replicated_multiclass(self):
+        from dmlc_core_tpu.models import HistGBT
+        from dmlc_core_tpu.parallel.mesh import local_mesh
+
+        X, y = self._data(n=1024, F=5)
+        m8 = HistGBT(n_trees=4, max_depth=3, n_bins=32, mesh=local_mesh(),
+                     objective="multi:softmax", num_class=3)
+        m1 = HistGBT(n_trees=4, max_depth=3, n_bins=32, mesh=local_mesh(1),
+                     objective="multi:softmax", num_class=3)
+        m8.fit(X, y)
+        m1.fit(X, y)
+        for t8, t1 in zip(m8.trees, m1.trees):
+            np.testing.assert_array_equal(t8["feat"], t1["feat"])
+            np.testing.assert_array_equal(t8["thr"], t1["thr"])
+            np.testing.assert_allclose(t8["leaf"], t1["leaf"],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_continue_then_early_stop_offsets_best_iteration(self, tmp_path):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=3000)
+        Xv, yv = self._data(n=1500, seed=5)
+        m = HistGBT(n_trees=6, max_depth=3, n_bins=32,
+                    objective="multi:softmax", num_class=3)
+        m.fit(X, y)
+        uri = str(tmp_path / "c.bin")
+        m.save_model(uri)
+        m2 = HistGBT.load_model(uri)
+        m2.param.init({"n_trees": 50, "learning_rate": 0.5})
+        m2.fit(X, y, eval_set=(Xv, yv), early_stopping_rounds=10)
+        # best_iteration must index into the COMBINED tree list (≥ priors)
+        assert m2.best_iteration is not None and m2.best_iteration >= 6
+        pd = m2.predict(Xv)
+        acc = (pd == yv).mean()
+        assert acc > 0.85, acc          # old trees not dropped
+
+    def test_bad_labels_rejected(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(n=500)
+        y[0] = 3.0                      # out of [0, 3)
+        m = HistGBT(n_trees=2, max_depth=2, n_bins=16,
+                    objective="multi:softmax", num_class=3)
+        with pytest.raises(Error):
+            m.fit(X, y)
+
+    def test_predict_proba_rejects_regression(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models import HistGBT
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 4)).astype(np.float32)
+        m = HistGBT(n_trees=2, max_depth=2, n_bins=16,
+                    objective="reg:squarederror")
+        m.fit(X, X[:, 0])
+        with pytest.raises(Error):
+            m.predict_proba(X)
