@@ -40,3 +40,4 @@ from dmlc_core_tpu.io.s3_filesys import S3FileSystem  # noqa: F401
 from dmlc_core_tpu.io.hdfs_filesys import HDFSFileSystem  # noqa: F401
 from dmlc_core_tpu.io.azure_filesys import AzureFileSystem  # noqa: F401
 from dmlc_core_tpu.io.gcs_filesys import GCSFileSystem  # noqa: F401
+from dmlc_core_tpu.io.http_filesys import HttpFileSystem  # noqa: F401

@@ -73,6 +73,22 @@ def http_request(
     raise HttpError(status, url, data)
 
 
+def http_probe_range(url: str) -> bool:
+    """Does the server honor Range requests?  Sends ``Range: bytes=0-0``
+    and reads ONLY the status — never the body, so a Range-ignoring
+    server's full-object 200 costs nothing.  416 (empty object) also
+    proves the server parses Range."""
+    req = urllib.request.Request(url, method="GET")
+    req.add_header("Range", "bytes=0-0")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status in (206, 416)
+    except urllib.error.HTTPError as e:
+        return e.code == 416
+    except urllib.error.URLError:
+        return False
+
+
 class RangedReadStream(SeekStream):
     """SeekStream over HTTP ranged GETs with a readahead buffer.
 

@@ -135,6 +135,24 @@ class InputSplit:
         return split
 
 
+def _split_multi_uri(uri: str) -> List[str]:
+    """Split a ';'-separated path list (reference ``src/io.cc`` behavior)
+    without mangling URLs whose query strings contain ';' (legacy
+    ``?a=1;b=2`` parameter separators): when the first path carries a
+    protocol, a fragment WITHOUT one cannot be a new path — it is rejoined
+    to its predecessor."""
+    frags = [s for s in uri.split(";") if s]
+    if not frags or "://" not in frags[0]:
+        return frags           # local paths: plain reference behavior
+    paths: List[str] = [frags[0]]
+    for frag in frags[1:]:
+        if "://" in frag:
+            paths.append(frag)
+        else:
+            paths[-1] += ";" + frag
+    return paths
+
+
 class InputSplitBase(InputSplit):
     """Byte-range sharding over a (multi-file) URI.
 
@@ -145,11 +163,21 @@ class InputSplitBase(InputSplit):
     """
 
     def __init__(self, uri: str, part: int, nparts: int, **_kw):
-        self._uri = URI(uri)
+        # ';'-separated multi-path URIs (reference: src/io.cc splits the
+        # path list before ListDirectory) — also the only way to shard
+        # over list-incapable backends like plain HTTP
+        paths = _split_multi_uri(uri)
+        CHECK(len(paths) > 0, f"InputSplit: empty uri {uri!r}")
+        self._uri = URI(paths[0])
         self._fs = FileSystem.get_instance(self._uri)
         if self._fs is None:
             log_fatal(f"InputSplit: no filesystem for {uri!r}")
-        self._files: List[FileInfo] = self._fs.list_directory_ex(self._uri)
+        self._files: List[FileInfo] = []
+        for path in paths:
+            u = URI(path)
+            CHECK(u.protocol == self._uri.protocol,
+                  "InputSplit: all ';' paths must share one protocol")
+            self._files += self._fs.list_directory_ex(u)
         self._files = [f for f in self._files if f.size > 0]
         self._sizes = [f.size for f in self._files]
         self._cum = [0]

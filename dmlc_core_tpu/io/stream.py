@@ -160,7 +160,13 @@ class _StreamFile(io.RawIOBase):
         return self._stream.write(bytes(b))
 
     def flush(self) -> None:
-        self._stream.flush()
+        try:
+            self._stream.flush()
+        except ValueError:
+            # underlying stream already closed (IOBase.close() flushes
+            # unconditionally, incl. at GC) — the adapter promises closing
+            # it is independent of the stream's lifetime
+            pass
 
     def seekable(self) -> bool:
         return isinstance(self._stream, SeekStream)

@@ -609,3 +609,46 @@ class TestStreamAsFile:
         assert ff.seekable()
         data = bytes(rd.read_all())
         assert data == b"line1\nline2\n"
+
+
+def test_input_split_semicolon_multipath(tmp_path):
+    from dmlc_core_tpu.io.input_split import InputSplit
+
+    files = []
+    want = set()
+    for k in range(3):
+        fp = tmp_path / f"f{k}.txt"
+        lines = [f"row-{k}-{i}" for i in range(100)]
+        want.update(lines)
+        fp.write_text("\n".join(lines) + "\n")
+        files.append(str(fp))
+    uri = ";".join(files)
+    got = set()
+    for part in range(2):
+        sp = InputSplit.create(uri, part, 2, "text")
+        while (rec := sp.next_record()) is not None:
+            got.add(bytes(rec).decode())
+        sp.close()
+    assert got == want
+
+
+def test_split_multi_uri_url_query_semicolons():
+    from dmlc_core_tpu.io.input_split import _split_multi_uri
+
+    # query-string ';' rejoined; real multi-URL lists still split
+    assert _split_multi_uri("https://h/f.bin?a=1;b=2") == \
+        ["https://h/f.bin?a=1;b=2"]
+    assert _split_multi_uri("https://h/a.rec;https://h/b.rec?x=1;y=2") == \
+        ["https://h/a.rec", "https://h/b.rec?x=1;y=2"]
+    assert _split_multi_uri("/a.txt;/b.txt") == ["/a.txt", "/b.txt"]
+
+
+def test_as_file_close_after_stream_closed(tmp_path):
+    from dmlc_core_tpu.io.stream import Stream
+
+    path = str(tmp_path / "x.bin")
+    s = Stream.create(path, "w")
+    f = s.as_file()
+    f.write(b"data")
+    s.close()
+    f.close()          # must not raise despite IOBase.close() → flush()

@@ -423,3 +423,72 @@ def test_sigv4_known_vector():
         in hdrs["Authorization"]
     assert hdrs["Authorization"].endswith(
         "Signature=b97d918cfa904a5beff61c982a1b6f458b799221646efd99d3219ec94cdf2500")
+
+
+class _PlainHttpHandler(_FakeBase):
+    """Static file server with HEAD + Range support (http_filesys tests)."""
+
+    def _blob(self):
+        return self.store.get(self.path.lstrip("/"))
+
+    def do_HEAD(self):  # noqa: N802
+        blob = self._blob()
+        if blob is None:
+            self._send(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):  # noqa: N802
+        blob = self._blob()
+        if blob is None:
+            self._send(404)
+            return
+        status, body = self._range(blob)
+        self._send(status, body)
+
+
+def test_http_readonly(serve):
+    payload = os.urandom(50_000)
+    base = serve(_PlainHttpHandler, {"data/f.bin": payload})
+    uri = f"{base}/data/f.bin"
+    with Stream.create(uri, "r") as s:
+        assert s.read_all() == payload
+    s = Stream.create_for_read(uri)
+    s.seek(49_000)
+    assert s.read(2000) == payload[49_000:]
+    s.close()
+    # writes rejected
+    from dmlc_core_tpu.base.logging import Error
+    with pytest.raises(Error):
+        Stream.create(uri, "w")
+
+
+class _NoRangeHandler(_FakeBase):
+    """Server that advertises nothing and ignores Range (probe must fatal)."""
+
+    def _blob(self):
+        return self.store.get(self.path.lstrip("/"))
+
+    def do_HEAD(self):  # noqa: N802
+        blob = self._blob()
+        if blob is None:
+            self._send(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+
+    def do_GET(self):  # noqa: N802
+        blob = self._blob()
+        self._send(200, blob if blob is not None else b"")
+
+
+def test_http_range_probe_rejects_nonranged_server(serve):
+    from dmlc_core_tpu.base.logging import Error
+
+    base = serve(_NoRangeHandler, {"f.bin": b"x" * 1000})
+    with pytest.raises(Error):
+        Stream.create(f"{base}/f.bin", "r")
