@@ -222,6 +222,45 @@ def _leaf_sums(node, g, h, n_leaf):
             jax.ops.segment_sum(h, safe, num_segments=n_leaf))
 
 
+def _metric_auc(margin, y):
+    """ROC-AUC via the rank-sum (Mann-Whitney) identity with MIDRANKS for
+    ties — GBT margins tie heavily (one tree = ≤2^depth distinct values),
+    and sort-order ranks would score an all-equal round as ~0/1 instead
+    of 0.5.  Degenerate single-class sets return 0.5 (neutral) rather
+    than NaN, which would poison the early-stopping comparison."""
+    s = jnp.sort(margin)
+    lo = jnp.searchsorted(s, margin, side="left")
+    hi = jnp.searchsorted(s, margin, side="right")
+    midrank = (lo + hi + 1) / 2.0                   # 1-based midranks
+    npos = jnp.sum(y)
+    nneg = y.shape[0] - npos
+    denom = npos * nneg
+    auc = (jnp.sum(midrank * y) - npos * (npos + 1) / 2) / jnp.where(
+        denom > 0, denom, 1.0)
+    return jnp.where(denom > 0, auc, 0.5)
+
+
+#: eval_metric name → (fn(margin, y) -> scalar, maximize?)
+EVAL_METRICS = {
+    "logloss": (lambda m, y: _Logistic.metric(m, y), False),
+    "error": (lambda m, y: jnp.mean((jax.nn.sigmoid(m) > 0.5) != (y > 0.5)),
+              False),
+    "auc": (_metric_auc, True),
+    "rmse": (lambda m, y: _SquaredError.metric(m, y), False),
+    "mae": (lambda m, y: jnp.mean(jnp.abs(m - y)), False),
+    "mlogloss": (lambda m, y: _Softmax.metric(m, y), False),
+    "merror": (lambda m, y: jnp.mean(
+        jnp.argmax(m, axis=1) != y.astype(jnp.int32)), False),
+}
+
+#: which metrics make sense for which objective's margin shape
+_METRICS_BY_OBJECTIVE = {
+    "binary:logistic": {"logloss", "error", "auc"},
+    "reg:squarederror": {"rmse", "mae"},
+    "multi:softmax": {"mlogloss", "merror"},
+}
+
+
 class HistGBTParam(Parameter):
     """Hyperparameters (XGBoost-compatible names where they exist)."""
 
@@ -245,6 +284,11 @@ class HistGBTParam(Parameter):
                              upper_bound=1.0,
                              description="per-tree feature sampling rate")
     seed = field(int, default=0, description="PRNG seed for sampling")
+    eval_metric = field(str, default="",
+                        enum=["", "logloss", "error", "auc", "rmse", "mae",
+                              "mlogloss", "merror"],
+                        description="validation metric (default: the "
+                                    "objective's own)")
     hist_method = field(str, default="auto",
                         enum=["auto", "segment", "matmul", "pallas"],
                         description="histogram engine (ops.histogram)")
@@ -279,6 +323,12 @@ class HistGBT:
             CHECK(self.param.num_class == 1,
                   f"num_class > 1 requires multi:softmax, "
                   f"got {self.param.objective!r}")
+        if self.param.eval_metric:
+            allowed = _METRICS_BY_OBJECTIVE[self.param.objective]
+            CHECK(self.param.eval_metric in allowed,
+                  f"eval_metric {self.param.eval_metric!r} incompatible "
+                  f"with objective {self.param.objective!r} "
+                  f"(allowed: {sorted(allowed)})")
         self._obj = OBJECTIVES[self.param.objective]
         self.cuts: Optional[jax.Array] = None          # [F, n_bins-1]
         self.trees: List[Dict[str, np.ndarray]] = []   # per-tree arrays
@@ -421,6 +471,12 @@ class HistGBT:
         self.best_score: Optional[float] = None
         self._early_stopped = bool(early_stopping_rounds)
         best_at = 0
+        if p.eval_metric:
+            metric_fn, maximize = EVAL_METRICS[p.eval_metric]
+            metric_name = p.eval_metric
+        else:
+            metric_fn, maximize = self._obj.metric, False
+            metric_name = "loss"
 
         t0 = get_time()
         chunks: List[Any] = []
@@ -436,15 +492,18 @@ class HistGBT:
             if eval_bins is not None:
                 eval_margin = self._apply_trees(eval_bins, trees_k,
                                                 eval_margin)
-                vloss = float(self._obj.metric(eval_margin, yv_d))
-                if self.best_score is None or vloss < self.best_score:
+                vloss = float(metric_fn(eval_margin, yv_d))
+                improved = (self.best_score is None
+                            or (vloss > self.best_score if maximize
+                                else vloss < self.best_score))
+                if improved:
                     self.best_score = vloss
                     self.best_iteration = n_prior + done - 1
                     best_at = done
                 elif (early_stopping_rounds
                       and done - best_at >= early_stopping_rounds):
-                    LOG("INFO", "early stop at round %d (best %.5f @ %d)",
-                        done, self.best_score, best_at)
+                    LOG("INFO", "early stop at round %d (best %s=%.5f @ %d)",
+                        done, metric_name, self.best_score, best_at)
                     break
         for trees_k in chunks:            # ONE host fetch per chunk
             t_np = jax.tree.map(np.asarray, trees_k)

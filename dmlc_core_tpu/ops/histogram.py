@@ -52,7 +52,7 @@ def histogram_methods() -> list[str]:
     return ["auto", "segment", "matmul", "pallas"]
 
 
-_TILE_ROWS = 1024  # pallas row-tile; shared by the kernel and its guard
+_TILE_ROWS = 4096  # pallas row-tile; shared by the kernel and its guard
 
 
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:

@@ -488,3 +488,66 @@ class TestMulticlass:
         m.fit(X, X[:, 0])
         with pytest.raises(Error):
             m.predict_proba(X)
+
+
+class TestEvalMetrics:
+    def _data(self, n, seed):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
+        return X, y
+
+    def test_auc_early_stopping_maximizes(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(4000, 0)
+        Xv, yv = self._data(2000, 9)
+        m = HistGBT(n_trees=150, max_depth=3, n_bins=32, learning_rate=0.5,
+                    eval_metric="auc")
+        m.fit(X, y, eval_set=(Xv, yv), early_stopping_rounds=10)
+        assert m.best_score is not None and 0.9 < m.best_score <= 1.0
+
+    def test_auc_matches_sklearn_style_oracle(self):
+        from dmlc_core_tpu.models.histgbt import _metric_auc
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        y = (rng.random(500) > 0.5).astype(np.float32)
+        s = rng.normal(size=500).astype(np.float32) + y  # informative score
+        # O(n^2) oracle: P(score_pos > score_neg)
+        pos = s[y == 1][:, None]
+        neg = s[y == 0][None, :]
+        want = (pos > neg).mean() + 0.5 * (pos == neg).mean()
+        got = float(_metric_auc(jnp.asarray(s), jnp.asarray(y)))
+        assert abs(got - want) < 1e-3, (got, want)
+
+    def test_error_metric(self):
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data(3000, 1)
+        Xv, yv = self._data(1000, 2)
+        m = HistGBT(n_trees=30, max_depth=3, n_bins=32, eval_metric="error")
+        m.fit(X, y, eval_set=(Xv, yv))
+        assert m.best_score is not None and m.best_score < 0.1
+
+    def test_auc_midranks_on_ties(self):
+        from dmlc_core_tpu.models.histgbt import _metric_auc
+        import jax.numpy as jnp
+
+        # all-tied margins must give exactly 0.5 regardless of label order
+        y = np.array([1, 1, 1, 0, 0, 0], np.float32)
+        s = np.zeros(6, np.float32)
+        assert float(_metric_auc(jnp.asarray(s), jnp.asarray(y))) == 0.5
+        # single-class validation set: neutral 0.5, not NaN
+        y1 = np.ones(6, np.float32)
+        assert float(_metric_auc(jnp.asarray(s), jnp.asarray(y1))) == 0.5
+
+    def test_eval_metric_objective_mismatch_rejected(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models import HistGBT
+
+        with pytest.raises(Error):
+            HistGBT(eval_metric="merror")          # binary obj, multi metric
+        with pytest.raises(Error):
+            HistGBT(objective="reg:squarederror", eval_metric="auc")
