@@ -242,13 +242,13 @@ def _metric_auc(margin, y):
 
 #: eval_metric name → (fn(margin, y) -> scalar, maximize?)
 EVAL_METRICS = {
-    "logloss": (lambda m, y: _Logistic.metric(m, y), False),
+    "logloss": (_Logistic.metric, False),
     "error": (lambda m, y: jnp.mean((jax.nn.sigmoid(m) > 0.5) != (y > 0.5)),
               False),
     "auc": (_metric_auc, True),
-    "rmse": (lambda m, y: _SquaredError.metric(m, y), False),
+    "rmse": (_SquaredError.metric, False),
     "mae": (lambda m, y: jnp.mean(jnp.abs(m - y)), False),
-    "mlogloss": (lambda m, y: _Softmax.metric(m, y), False),
+    "mlogloss": (_Softmax.metric, False),
     "merror": (lambda m, y: jnp.mean(
         jnp.argmax(m, axis=1) != y.astype(jnp.int32)), False),
 }
@@ -285,8 +285,7 @@ class HistGBTParam(Parameter):
                              description="per-tree feature sampling rate")
     seed = field(int, default=0, description="PRNG seed for sampling")
     eval_metric = field(str, default="",
-                        enum=["", "logloss", "error", "auc", "rmse", "mae",
-                              "mlogloss", "merror"],
+                        enum=[""] + sorted(EVAL_METRICS),
                         description="validation metric (default: the "
                                     "objective's own)")
     hist_method = field(str, default="auto",

@@ -56,14 +56,17 @@ _TILE_ROWS = 4096  # pallas row-tile; shared by the kernel and its guard
 
 
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
-    """The factored kernel works for any n_bins; the only requirement is
-    that its [F, 2·N·hi, lo] f32 accumulator plus the row tile's working
-    values stay VMEM-resident."""
+    """The factored kernel works for any n_bins; the binding constraint is
+    the [Fp, A, lo] f32 accumulator block.  Empirically calibrated on
+    v5e at tile_rows=4096: nominal accumulators up to 32MB compile and
+    run (Mosaic windows the out block; fori_loop temporaries are reused,
+    so per-row working-set formulas wildly overestimate), 64MB fails —
+    the 24MB budget keeps a safety margin below the measured boundary."""
     lo = min(n_bins, 128)
     hi = -(-n_bins // lo)
-    vmem = (n_features * 2 * n_nodes * hi * max(lo, 128) * 4   # accumulator
-            + _TILE_ROWS * (n_features * 4 + 6 * 128 * 2))     # tile values
-    return vmem <= 12 << 20
+    fp = -(-n_features // 8) * 8
+    acc = fp * 2 * n_nodes * hi * max(lo, 128) * 4
+    return acc <= 24 << 20
 
 
 def build_histogram(

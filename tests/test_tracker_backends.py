@@ -7,7 +7,10 @@ way the reference's backends are thin cmdline generators over the
 """
 
 import json
+import pathlib
 import shlex
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 import pytest
 
@@ -137,3 +140,32 @@ class TestOpts:
                             "--max-attempts", "5", "--", "x"])
         assert (opts.queue, opts.worker_cores, opts.worker_memory,
                 opts.image, opts.max_attempts) == ("q", 8, 1024, "img", 5)
+
+
+def test_dmlc_submit_cli_local_end_to_end(tmp_path):
+    """The real CLI, as a user runs it: fork workers via --cluster=local,
+    each worker connects to the tracker and reports its rank to a file."""
+    import subprocess
+    import sys
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {str(REPO)!r})\n"
+        "from dmlc_core_tpu.tracker.tracker import RabitTracker\n"
+        "uri = os.environ['DMLC_TRACKER_URI']\n"
+        "port = int(os.environ['DMLC_LEGACY_TRACKER_PORT'])\n"
+        "info = RabitTracker.worker_connect(uri, port)\n"
+        f"open(os.path.join({str(tmp_path)!r}, f\"rank{{info['rank']}}\"), 'w')"
+        ".write(str(info['num_worker']))\n"
+        "RabitTracker.worker_connect(uri, port, cmd='shutdown')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "dmlc-submit"), "--cluster=local",
+         "--num-workers=4", "--start-legacy-tracker",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    ranks = sorted(p.name for p in tmp_path.glob("rank*"))
+    assert ranks == ["rank0", "rank1", "rank2", "rank3"], ranks
+    assert all((tmp_path / r).read_text() == "4" for r in ranks)
