@@ -42,6 +42,7 @@ from dmlc_core_tpu.parallel.kvstore import KVStore
 from dmlc_core_tpu.parallel.mesh import local_mesh
 from dmlc_core_tpu.parallel.ring_attention import (
     reference_attention, ring_attention)
+from dmlc_core_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = ["BERT", "BERTParam"]
 
@@ -58,6 +59,9 @@ class BERTParam(Parameter):
     learning_rate = field(float, default=1e-3, lower_bound=0.0)
     grad_sync = field(str, default="fused", enum=["fused", "kvstore"],
                       description="in-step psum vs KVStore dist_sync")
+    sp_method = field(str, default="ring", enum=["ring", "ulysses"],
+                      description="sequence-parallel attention: K/V ring "
+                                  "rotation vs all-to-all head scatter")
 
 
 def _norm(x, gamma, beta, eps=1e-6):
@@ -94,6 +98,12 @@ class BERT:
         p = self.param
         CHECK_EQ(p.n_heads % max(self._tp, 1), 0, "n_heads % tp != 0")
         CHECK_EQ(p.d_ff % max(self._tp, 1), 0, "d_ff % tp != 0")
+        if p.sp_method == "ulysses" and self._has_seq:
+            # fail at construction with the USER's numbers — inside
+            # shard_map the error would report shard-local head counts
+            CHECK_EQ((p.n_heads // max(self._tp, 1)) % max(self._sp, 1), 0,
+                     f"ulysses needs (n_heads/tp) % sp == 0 "
+                     f"(n_heads={p.n_heads}, tp={self._tp}, sp={self._sp})")
         self.params: Optional[Dict[str, jax.Array]] = None
         self.opt_state: Optional[Dict[str, jax.Array]] = None
         self._step_fn: Optional[Callable] = None
@@ -192,7 +202,9 @@ class BERT:
             qkv = jnp.einsum("bsd,cdhk->cbshk", h.astype(jnp.float32),
                              params[f"l{i}.wqkv"]).astype(jnp.bfloat16)
             if self._has_seq:
-                attn = ring_attention(qkv[0], qkv[1], qkv[2], axis_name="seq")
+                sp_attn = (ulysses_attention if p.sp_method == "ulysses"
+                           else ring_attention)
+                attn = sp_attn(qkv[0], qkv[1], qkv[2], axis_name="seq")
             else:
                 attn = reference_attention(qkv[0], qkv[1], qkv[2])
             o = jnp.einsum("bshk,hkd->bsd", attn.astype(jnp.float32),

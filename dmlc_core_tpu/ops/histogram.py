@@ -66,7 +66,8 @@ def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
     hi = -(-n_bins // lo)
     fp = -(-n_features // 8) * 8
     acc = fp * 2 * n_nodes * hi * max(lo, 128) * 4
-    return acc <= 24 << 20
+    bins_tile = fp * _TILE_ROWS            # [Fp, R] u8 input block
+    return acc <= 24 << 20 and bins_tile <= 8 << 20
 
 
 def build_histogram(

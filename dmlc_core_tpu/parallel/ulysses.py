@@ -1,0 +1,76 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two long-context shardings (SURVEY.md §5 obligation;
+sibling of :mod:`~dmlc_core_tpu.parallel.ring_attention`): instead of
+rotating K/V blocks around a ring, ONE ``all_to_all`` re-shards the
+activations from sequence-sharded to head-sharded, every device then runs
+*full-sequence* attention for its subset of heads (dense local compute —
+ideal for the MXU / a fused flash kernel), and a second ``all_to_all``
+restores sequence sharding.
+
+Trade-offs vs ring attention (why both exist):
+
+* Ulysses moves ``2·B·S·H·D`` elements in two collective bursts and needs
+  ``n_heads % P == 0``; compute is one dense local attention (best MXU
+  utilization, trivially composable with a flash kernel).
+* Ring keeps K/V moving in P overlappable hops and has no head-count
+  constraint; better when heads < devices or when overlap hides the ICI
+  time.
+
+Both are exact. Like ``ring_attention``, this MUST run inside a
+``shard_map`` that maps the token axis over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+from dmlc_core_tpu.parallel.ring_attention import reference_attention
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(
+    q: jax.Array,           # [B, S_local, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    local_attn: Optional[Callable] = None,
+) -> jax.Array:
+    """Exact attention over sequence-sharded Q/K/V via two all-to-alls.
+
+    ``local_attn(q, k, v, causal, scale) -> out`` runs the full-sequence
+    attention for this device's head subset (default: the dense softmax
+    oracle; pass a flash kernel for long sequences).  Requires
+    ``H % axis_size == 0``.  Returns ``[B, S_local, H, D]``.
+    """
+    P = lax.psum(1, axis_name)
+    B, S_loc, H, D = q.shape
+    if H % P:
+        raise ValueError(f"ulysses: n_heads {H} not divisible by axis {P}")
+
+    def seq_to_heads(x):
+        # [B, S/P, H, D] → [B, S, H/P, D]: head dim split across devices,
+        # received seq blocks concatenated in device (= sequence) order
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # inverse: [B, S, H/P, D] → [B, S/P, H, D]; received head blocks
+        # concatenate in device order = original head order
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh = seq_to_heads(q)
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    if local_attn is None:
+        out = reference_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = local_attn(qh, kh, vh, causal, scale)
+    return heads_to_seq(out)
