@@ -40,8 +40,8 @@ from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.parallel.collectives import replicate_fwd_psum_bwd
 from dmlc_core_tpu.parallel.kvstore import KVStore
 from dmlc_core_tpu.parallel.mesh import local_mesh
-from dmlc_core_tpu.parallel.ring_attention import (
-    reference_attention, ring_attention)
+from dmlc_core_tpu.ops.attention import local_attention
+from dmlc_core_tpu.parallel.ring_attention import ring_attention
 from dmlc_core_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = ["BERT", "BERTParam"]
@@ -206,7 +206,7 @@ class BERT:
                            else ring_attention)
                 attn = sp_attn(qkv[0], qkv[1], qkv[2], axis_name="seq")
             else:
-                attn = reference_attention(qkv[0], qkv[1], qkv[2])
+                attn = local_attention(qkv[0], qkv[1], qkv[2])
             o = jnp.einsum("bshk,hkd->bsd", attn.astype(jnp.float32),
                            params[f"l{i}.wo"])
             o = join_model(o)                              # row-parallel join

