@@ -552,8 +552,6 @@ class HistGBT:
         from dmlc_core_tpu.parallel import collectives as coll
 
         p = self.param
-        CHECK(p.num_class == 1,
-              "fit_external: multi:softmax not supported yet — use fit()")
         B = p.n_bins
         depth = p.max_depth
         n_leaf = 1 << depth
@@ -583,53 +581,50 @@ class HistGBT:
             self.cuts = sketch.finalize(B, allgather_fn=self._maybe_allgather())
 
         # -- pass 2: bin pages (uint8) -------------------------------------
+        K_cls = p.num_class
         pages: List[Dict[str, np.ndarray]] = []
         for block in row_iter:
             X = block.to_dense(F)
             bins = np.asarray(apply_bins(jnp.asarray(X), self.cuts))
             w = (np.asarray(block.weight, np.float32)
                  if block.weight is not None else np.ones(len(X), np.float32))
+            m_shape = (len(X), K_cls) if K_cls > 1 else (len(X),)
             pages.append({
                 "bins": bins,
                 "y": np.asarray(block.label, np.float32),
                 "w": w,
-                "preds": np.full(len(X), p.base_score, np.float32),
+                "preds": np.full(m_shape, p.base_score, np.float32),
             })
+        if K_cls > 1:
+            for pg in pages:
+                if len(pg["y"]):   # empty shard pages are legal
+                    CHECK(pg["y"].min() >= 0 and pg["y"].max() < K_cls,
+                          f"multi:softmax labels must be in [0, {K_cls})")
 
         distributed = coll.world_size() > 1
         obj = self._obj
-        t0 = get_time()
-        for r in range(p.n_trees):
-            # per-round sampling, same semantics as fit(): rows drawn per
-            # worker (rank-salted), feature mask identical across workers
-            feat_mask = None
-            if p.colsample_bytree < 1.0:
-                crng = np.random.default_rng([p.seed, r, 1])
-                n_keep = max(1, int(np.ceil(p.colsample_bytree * F)))
-                scores = crng.random(F)
-                feat_mask = jnp.asarray(
-                    scores <= np.sort(scores)[n_keep - 1])
-            rrng = (np.random.default_rng([p.seed, r, 2, coll.rank()])
-                    if p.subsample < 1.0 else None)
-            # grad/hess per page for this round
+
+        def grow_one_tree(col, feat_mask):
+            """One level-wise tree over all pages using class column
+            ``col`` of g/h (None for single-output); leaves pg['node'] at
+            the final leaf assignment."""
             for pg in pages:
-                g, h = obj.grad_hess(jnp.asarray(pg["preds"]),
-                                     jnp.asarray(pg["y"]))
-                pg["g"] = np.asarray(g) * pg["w"]
-                pg["h"] = np.asarray(h) * pg["w"]
-                if rrng is not None:
-                    keep = rrng.random(len(pg["y"])) < p.subsample
-                    pg["g"] = np.where(keep, pg["g"], 0.0)
-                    pg["h"] = np.where(keep, pg["h"], 0.0)
                 pg["node"] = np.zeros(len(pg["y"]), np.int32)
+
+            def gh(pg):
+                if col is None:
+                    return pg["g"], pg["h"]
+                return pg["g"][:, col], pg["h"][:, col]
+
             feats, thrs = [], []
             for level in range(depth):
                 n_nodes = 1 << level
                 hist = None
                 for pg in pages:
+                    g_c, h_c = gh(pg)
                     ph = build_histogram(
                         jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
-                        jnp.asarray(pg["g"]), jnp.asarray(pg["h"]),
+                        jnp.asarray(g_c), jnp.asarray(h_c),
                         n_nodes, B, p.hist_method)
                     hist = ph if hist is None else hist + ph
                 hist_np = np.asarray(hist)
@@ -645,9 +640,10 @@ class HistGBT:
             gsum = np.zeros(n_leaf, np.float32)
             hsum = np.zeros(n_leaf, np.float32)
             for pg in pages:
+                g_c, h_c = gh(pg)
                 gs, hs = _leaf_sums(jnp.asarray(pg["node"]),
-                                    jnp.asarray(pg["g"]),
-                                    jnp.asarray(pg["h"]), n_leaf)
+                                    jnp.asarray(g_c),
+                                    jnp.asarray(h_c), n_leaf)
                 gsum += np.asarray(gs)
                 hsum += np.asarray(hs)
             if distributed:
@@ -655,11 +651,51 @@ class HistGBT:
                 hsum = coll.allreduce(hsum)
             leaf = (-gsum / (hsum + p.reg_lambda) * p.learning_rate
                     ).astype(np.float32)
+            return np.stack(feats), np.stack(thrs), leaf
+
+        t0 = get_time()
+        for r in range(p.n_trees):
+            # per-round sampling, same semantics as fit(): rows drawn per
+            # worker (rank-salted), feature mask identical across workers
+            feat_mask = None
+            if p.colsample_bytree < 1.0:
+                crng = np.random.default_rng([p.seed, r, 1])
+                n_keep = max(1, int(np.ceil(p.colsample_bytree * F)))
+                scores = crng.random(F)
+                feat_mask = jnp.asarray(
+                    scores <= np.sort(scores)[n_keep - 1])
+            rrng = (np.random.default_rng([p.seed, r, 2, coll.rank()])
+                    if p.subsample < 1.0 else None)
+            # grad/hess per page for this round (rows shared across the
+            # round's class trees, like fit())
             for pg in pages:
-                pg["preds"] = pg["preds"] + leaf[pg["node"]]
-            self.trees.append({
-                "feat": np.stack(feats), "thr": np.stack(thrs), "leaf": leaf,
-            })
+                g, h = obj.grad_hess(jnp.asarray(pg["preds"]),
+                                     jnp.asarray(pg["y"]))
+                w_col = pg["w"] if K_cls == 1 else pg["w"][:, None]
+                pg["g"] = np.asarray(g) * w_col
+                pg["h"] = np.asarray(h) * w_col
+                if rrng is not None:
+                    keep = rrng.random(len(pg["y"])) < p.subsample
+                    k_col = keep if K_cls == 1 else keep[:, None]
+                    pg["g"] = np.where(k_col, pg["g"], 0.0)
+                    pg["h"] = np.where(k_col, pg["h"], 0.0)
+            if K_cls == 1:
+                feats, thrs, leaf = grow_one_tree(None, feat_mask)
+                for pg in pages:
+                    pg["preds"] = pg["preds"] + leaf[pg["node"]]
+                self.trees.append({"feat": feats, "thr": thrs, "leaf": leaf})
+            else:
+                per_class = []
+                for c in range(K_cls):
+                    feats, thrs, leaf = grow_one_tree(c, feat_mask)
+                    for pg in pages:
+                        pg["preds"][:, c] += leaf[pg["node"]]
+                    per_class.append((feats, thrs, leaf))
+                self.trees.append({
+                    "feat": np.stack([t[0] for t in per_class]),
+                    "thr": np.stack([t[1] for t in per_class]),
+                    "leaf": np.stack([t[2] for t in per_class]),
+                })
             if eval_every and (r + 1) % eval_every == 0:
                 # mean of per-row losses across ALL pages, then the
                 # objective's finalizer (sqrt for rmse) — a page-wise mean

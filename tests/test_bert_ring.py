@@ -234,7 +234,8 @@ class TestLocalAttention:
         from dmlc_core_tpu.parallel.ring_attention import reference_attention
 
         # CPU: never flash-eligible; dense path must be exact
-        assert not flash_eligible(2, 512, 4, 64)
+        if jax.default_backend() != "tpu":
+            assert not flash_eligible(2, 512, 4, 64)
         q = jnp.asarray(rng.normal(size=(2, 64, 4, 16)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(2, 64, 4, 16)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(2, 64, 4, 16)).astype(np.float32))

@@ -162,3 +162,41 @@ class TestFitExternal:
             m.fit_external(it)
             it.close()
             assert len(m.trees) == 3
+
+
+def test_external_memory_multiclass(tmp_path):
+    """fit_external with multi:softmax must match in-core fit() given the
+    same cuts (same data, single worker, deterministic splits)."""
+    import numpy as np
+
+    from dmlc_core_tpu.data.iter import RowBlockIter
+    from dmlc_core_tpu.models import HistGBT
+
+    rng = np.random.default_rng(0)
+    K, n, F = 3, 3000, 6
+    centers = np.random.default_rng(42).normal(scale=3.0, size=(K, 2))
+    y = rng.integers(0, K, n)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    X[:, :2] += centers[y]
+
+    svm = tmp_path / "mc.svm"
+    with open(svm, "w") as f:
+        for i in range(n):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(F))
+            f.write(f"{y[i]} {feats}\n")
+
+    ext = HistGBT(n_trees=8, max_depth=3, n_bins=32,
+                  objective="multi:softmax", num_class=K)
+    it = RowBlockIter.create(str(svm), 0, 1, "libsvm")
+    ext.fit_external(it, num_col=F)
+    acc_ext = (ext.predict(X) == y).mean()
+    assert acc_ext > 0.9, acc_ext
+
+    core = HistGBT(n_trees=8, max_depth=3, n_bins=32,
+                   objective="multi:softmax", num_class=K)
+    core.fit(X, y.astype(np.float32), cuts=ext.cuts)
+    for te, tc in zip(ext.trees, core.trees):
+        np.testing.assert_array_equal(te["feat"], tc["feat"])
+        np.testing.assert_array_equal(te["thr"], tc["thr"])
+        np.testing.assert_allclose(te["leaf"], tc["leaf"],
+                                   rtol=1e-3, atol=1e-4)
