@@ -51,8 +51,22 @@ __all__ = ["HistGBT", "HistGBTParam", "OBJECTIVES"]
 OBJECTIVES: Registry = Registry.get("gbt_objective")
 
 
+class _ObjectiveBase:
+    """Shared objective plumbing: the metric is the mean of per-row
+    losses and the external-memory path's finalizer is the identity —
+    objectives override only where that isn't true (rmse)."""
+
+    @classmethod
+    def metric(cls, pred, y):
+        return jnp.mean(cls.row_loss(pred, y))
+
+    @staticmethod
+    def finalize_mean_loss(m: float) -> float:
+        return m
+
+
 @OBJECTIVES.register("binary:logistic")
-class _Logistic:
+class _Logistic(_ObjectiveBase):
     """grad/hess of log loss on raw margins; transform = sigmoid."""
 
     @staticmethod
@@ -65,22 +79,14 @@ class _Logistic:
         return jax.nn.sigmoid(pred)
 
     @staticmethod
-    def row_loss(pred, y):  # per-row logloss (mean of these = the metric)
+    def row_loss(pred, y):  # per-row logloss
         p = jax.nn.sigmoid(pred)
         eps = 1e-7
         return -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
 
-    @staticmethod
-    def metric(pred, y):  # logloss
-        return jnp.mean(_Logistic.row_loss(pred, y))
-
-    @staticmethod
-    def finalize_mean_loss(m: float) -> float:
-        return m
-
 
 @OBJECTIVES.register("multi:softmax")
-class _Softmax:
+class _Softmax(_ObjectiveBase):
     """K-class softmax objective (XGBoost ``multi:softmax``) — margins are
     [n, K]; grad/hess per class from the full softmax row.  ``predict``
     returns argmax classes (``multi:softprob`` = same training, transform
@@ -107,17 +113,9 @@ class _Softmax:
         return -jnp.take_along_axis(
             logp, y.astype(jnp.int32)[:, None], axis=1)[:, 0]
 
-    @staticmethod
-    def metric(pred, y):
-        return jnp.mean(_Softmax.row_loss(pred, y))
-
-    @staticmethod
-    def finalize_mean_loss(m: float) -> float:
-        return m
-
 
 @OBJECTIVES.register("reg:squarederror")
-class _SquaredError:
+class _SquaredError(_ObjectiveBase):
     @staticmethod
     def grad_hess(pred, y):
         return pred - y, jnp.ones_like(pred)
@@ -130,9 +128,9 @@ class _SquaredError:
     def row_loss(pred, y):  # per-row squared error
         return (pred - y) ** 2
 
-    @staticmethod
-    def metric(pred, y):  # rmse = sqrt of the mean row loss
-        return jnp.sqrt(jnp.mean(_SquaredError.row_loss(pred, y)))
+    @classmethod
+    def metric(cls, pred, y):  # rmse = sqrt of the mean row loss
+        return jnp.sqrt(jnp.mean(cls.row_loss(pred, y)))
 
     @staticmethod
     def finalize_mean_loss(m: float) -> float:
@@ -380,8 +378,8 @@ class HistGBT:
         # continued training (xgb_model semantics): keep the existing bin
         # boundaries — the loaded trees' thresholds are only meaningful
         # against them — and start margins from the existing ensemble
-        continuing = len(self.trees) > 0
         n_prior = len(self.trees)      # best_iteration indexes the FULL list
+        continuing = n_prior > 0
         if continuing:
             CHECK(self.cuts is not None, "continue-fit without cuts")
         else:
@@ -405,7 +403,7 @@ class HistGBT:
         y_d = jax.device_put(y, row_sharding)
         w_d = jax.device_put(mask, row_sharding)
         K_cls = p.num_class
-        margin_shape = (n + n_pad, K_cls) if K_cls > 1 else (n + n_pad,)
+        margin_shape = self._margin_shape(n + n_pad)
         init_margin = np.full(margin_shape, p.base_score, np.float32)
         if continuing:
             init_margin = np.asarray(self._apply_trees(
@@ -460,14 +458,14 @@ class HistGBT:
             Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
             yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
             eval_bins = apply_bins(jnp.asarray(Xv), self.cuts)
-            ev_shape = (len(yv), K_cls) if K_cls > 1 else (len(yv),)
-            eval_margin = jnp.full(ev_shape, p.base_score, jnp.float32)
+            eval_margin = jnp.full(self._margin_shape(len(yv)),
+                                   p.base_score, jnp.float32)
             if continuing:
                 eval_margin = self._apply_trees(
                     eval_bins, self._stacked_trees(self.trees), eval_margin)
             yv_d = jnp.asarray(yv)
-        self.best_iteration: Optional[int] = None
-        self.best_score: Optional[float] = None
+        self.best_iteration = None
+        self.best_score = None
         self._early_stopped = bool(early_stopping_rounds)
         best_at = 0
         if p.eval_metric:
@@ -487,7 +485,7 @@ class HistGBT:
             done += K if fn is kfn else rem
             if eval_every and done % eval_every == 0:
                 loss = float(self._obj.metric(preds, y_d))
-                LOG("INFO", "round %d: %s=%.5f", done, "loss", loss)
+                LOG("INFO", "round %d: loss=%.5f", done, loss)
             if eval_bins is not None:
                 eval_margin = self._apply_trees(eval_bins, trees_k,
                                                 eval_margin)
@@ -588,12 +586,12 @@ class HistGBT:
             bins = np.asarray(apply_bins(jnp.asarray(X), self.cuts))
             w = (np.asarray(block.weight, np.float32)
                  if block.weight is not None else np.ones(len(X), np.float32))
-            m_shape = (len(X), K_cls) if K_cls > 1 else (len(X),)
             pages.append({
                 "bins": bins,
                 "y": np.asarray(block.label, np.float32),
                 "w": w,
-                "preds": np.full(m_shape, p.base_score, np.float32),
+                "preds": np.full(self._margin_shape(len(X)), p.base_score,
+                                 np.float32),
             })
         if K_cls > 1:
             for pg in pages:
@@ -882,10 +880,10 @@ class HistGBT:
             n_trees = self.best_iteration + 1   # XGBoost early-stop default
         use = self.trees if n_trees is None else self.trees[:n_trees]
         stacked = self._stacked_trees(use)
-        shape = ((bins.shape[0], p.num_class) if p.num_class > 1
-                 else (bins.shape[0],))
         margin = self._apply_trees(
-            bins, stacked, jnp.full(shape, p.base_score, jnp.float32))
+            bins, stacked,
+            jnp.full(self._margin_shape(bins.shape[0]), p.base_score,
+                     jnp.float32))
         if output_margin:
             return np.asarray(margin)
         return np.asarray(self._obj.transform(margin))
@@ -908,6 +906,11 @@ class HistGBT:
         """Raw training-set margins after fit (real rows only)."""
         CHECK(hasattr(self, "_train_preds"), "call fit first")
         return np.asarray(self._train_preds)[: self._n_real_rows]
+
+    def _margin_shape(self, n: int) -> Tuple[int, ...]:
+        """Margins are [n] single-output, [n, K] multiclass."""
+        K = self.param.num_class
+        return (n, K) if K > 1 else (n,)
 
     @staticmethod
     def _stacked_trees(trees: List[Dict[str, np.ndarray]]) -> Dict[str, jax.Array]:

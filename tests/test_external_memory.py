@@ -167,8 +167,6 @@ class TestFitExternal:
 def test_external_memory_multiclass(tmp_path):
     """fit_external with multi:softmax must match in-core fit() given the
     same cuts (same data, single worker, deterministic splits)."""
-    import numpy as np
-
     from dmlc_core_tpu.data.iter import RowBlockIter
     from dmlc_core_tpu.models import HistGBT
 
@@ -180,10 +178,7 @@ def test_external_memory_multiclass(tmp_path):
     X[:, :2] += centers[y]
 
     svm = tmp_path / "mc.svm"
-    with open(svm, "w") as f:
-        for i in range(n):
-            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(F))
-            f.write(f"{y[i]} {feats}\n")
+    _write_libsvm(svm, X, y)
 
     ext = HistGBT(n_trees=8, max_depth=3, n_bins=32,
                   objective="multi:softmax", num_class=K)
