@@ -1,0 +1,20 @@
+#!/bin/sh
+# Build and run the native test harness under each sanitizer — the
+# reference's CMake USE_SANITIZER story (SURVEY.md §4-5): TSan is the
+# race detector for the lock-free queue/spinlock, ASan+LSan catch
+# leaks/overflows in the recordio/parse buffers, UBSan the arithmetic.
+#
+# Usage: scripts/native_sanitize_test.sh [address|thread|undefined ...]
+set -e
+cd "$(dirname "$0")/.."
+SANS="${*:-address thread undefined}"
+SRCS="cpp/test_native.cc cpp/mpmc_queue.cc cpp/recordio.cc cpp/fastparse.cc cpp/prefetch.cc"
+for san in $SANS; do
+  out="build/native_test_$san"
+  mkdir -p build
+  echo "== $san =="
+  g++ -std=c++17 -O1 -g -fno-omit-frame-pointer -fsanitize="$san" \
+      $SRCS -o "$out" -lpthread
+  "./$out"
+done
+echo "ALL SANITIZER RUNS PASSED"
