@@ -21,13 +21,18 @@ from dmlc_core_tpu.io.lockfree import (
 
 
 def test_native_engine_is_live():
-    # The build ships libdmlctpu.so; the lock-free engine must be the real
-    # one in CI, not the pure-Python fallback — unless the env explicitly
-    # disables it (DMLC_TPU_NATIVE_IO=0 re-runs this suite on the fallback).
+    # When libdmlctpu.so is built (`make -C cpp`; it is not checked in),
+    # the lock-free engine must be the real one, not the pure-Python
+    # fallback — unless the env explicitly disables it
+    # (DMLC_TPU_NATIVE_IO=0 re-runs this suite on the fallback).
     import os
 
     if os.environ.get("DMLC_TPU_NATIVE_IO", "1") == "0":
         pytest.skip("native engine disabled via DMLC_TPU_NATIVE_IO=0")
+    so = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "build", "libdmlctpu.so")
+    if not os.path.exists(so):
+        pytest.skip("native lib not built (make -C cpp)")
     assert native_queue_available()
 
 
