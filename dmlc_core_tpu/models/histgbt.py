@@ -530,6 +530,7 @@ class HistGBT:
         eval_every: int = 0,
         sketch_pages: int = 32,
         cuts: Optional[jax.Array] = None,
+        cache_device: bool = False,
     ) -> "HistGBT":
         """Out-of-core boosting over a :class:`RowBlockIter` (sparse CSR
         pages from a Parser/DiskRowIter — the Criteo-scale path).
@@ -545,6 +546,12 @@ class HistGBT:
 
         Trees produced are the same arrays as :meth:`fit`, so
         :meth:`predict` and checkpointing work unchanged.
+
+        ``cache_device=True`` keeps the binned uint8 pages resident on
+        device instead of re-uploading each page ``depth`` times per tree:
+        much faster when the binned data fits HBM (it is 4× smaller than
+        the raw f32 matrix), while the default keeps device memory bounded
+        by one page — the true out-of-core mode.
         """
         from dmlc_core_tpu.ops.quantile import SketchAccumulator
         from dmlc_core_tpu.parallel import collectives as coll
@@ -580,10 +587,13 @@ class HistGBT:
 
         # -- pass 2: bin pages (uint8) -------------------------------------
         K_cls = p.num_class
-        pages: List[Dict[str, np.ndarray]] = []
+        pages: List[Dict[str, Any]] = []   # "bins" is a jax.Array when cache_device
         for block in row_iter:
             X = block.to_dense(F)
-            bins = np.asarray(apply_bins(jnp.asarray(X), self.cuts))
+            bins = apply_bins(jnp.asarray(X), self.cuts)
+            if not cache_device:
+                bins = np.asarray(bins)    # spill to host; one page on
+                                           # device at a time (out-of-core)
             w = (np.asarray(block.weight, np.float32)
                  if block.weight is not None else np.ones(len(X), np.float32))
             pages.append({

@@ -1,0 +1,50 @@
+"""RecordIO → ResNet training pipeline (BASELINE config 2's shape).
+
+Writes a small synthetic image dataset as sharded RecordIO files (the
+MXNet `.rec` wire format), then trains a ResNet over them through the
+full data plane: sharded `InputSplit` → record unpack → `DeviceFeed`
+double-buffered infeed → jitted train steps, reporting throughput and
+the infeed stall fraction.
+
+Run: python examples/resnet_recordio.py
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_core_tpu.data.image_record import pack_image_record
+from dmlc_core_tpu.io.recordio import RecordIOWriter
+from dmlc_core_tpu.models.resnet import ResNetTrainer
+
+
+def write_shards(root, n_shards=2, per_shard=256, hw=32):
+    rng = np.random.default_rng(0)
+    for s in range(n_shards):
+        with RecordIOWriter(os.path.join(root, f"part-{s}.rec")) as w:
+            for _ in range(per_shard):
+                label = int(rng.integers(0, 10))
+                img = (rng.random((hw, hw, 3)) * 255).astype(np.uint8)
+                # class signal: channel 0 brightness tracks the label
+                img[..., 0] = np.clip(img[..., 0] // 4 + label * 25, 0, 255)
+                w.write_record(pack_image_record(img, label))
+
+
+def main():
+    root = tempfile.mkdtemp()
+    write_shards(root)
+
+    trainer = ResNetTrainer(variant="resnet18", num_classes=10,
+                            learning_rate=0.05)
+    stats = trainer.fit_from_records(
+        os.path.join(root, "part-*.rec"),
+        batch_size=64, image_shape=(32, 32, 3), epochs=3, log_every=8)
+    print({k: round(v, 4) if isinstance(v, float) else v
+           for k, v in stats.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -195,3 +195,24 @@ def test_external_memory_multiclass(tmp_path):
         np.testing.assert_array_equal(te["thr"], tc["thr"])
         np.testing.assert_allclose(te["leaf"], tc["leaf"],
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_cache_device_matches_default(tmp_path):
+    from dmlc_core_tpu.data.iter import RowBlockIter
+    from dmlc_core_tpu.models import HistGBT
+
+    X, y = _synth(2000, 5)
+    svm = tmp_path / "c.svm"
+    _write_libsvm(svm, X, y)
+
+    models = {}
+    for cache in (False, True):
+        m = HistGBT(n_trees=5, max_depth=3, n_bins=32)
+        it = RowBlockIter.create(str(svm), 0, 1, "libsvm")
+        m.fit_external(it, num_col=5, cache_device=cache)
+        it.close()
+        models[cache] = m
+    for t0, t1 in zip(models[False].trees, models[True].trees):
+        np.testing.assert_array_equal(t0["feat"], t1["feat"])
+        np.testing.assert_array_equal(t0["thr"], t1["thr"])
+        np.testing.assert_allclose(t0["leaf"], t1["leaf"], rtol=1e-5)
