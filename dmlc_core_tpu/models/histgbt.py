@@ -141,13 +141,14 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
                      with_child_sums: bool = False):
     """Greedy per-node split chooser over a gradient histogram.
 
-    hist [2,N,F,B] → (feat [N], thr [N]); degenerate split (feat 0,
-    thr B-1 → everyone left) when gain ≤ gamma.  Shared by the in-core
-    shard_map round and the external-memory page loop.
+    hist [2,N,F,B] → (feat [N], thr [N], split_gain [N]); degenerate
+    split (feat 0, thr B-1 → everyone left, gain 0) when gain ≤ gamma.
+    Shared by the in-core shard_map round and the external-memory page
+    loop.
 
     ``with_child_sums=True`` additionally returns the children's
     ``(g_sum, h_sum)`` as ``[2N]`` arrays (leaf order: left=2i,
-    right=2i+1).  The cumsum evaluated at the chosen threshold IS the
+    right=2i+1) after the gain.  The cumsum evaluated at the chosen threshold IS the
     left child's sum and parent − left the right's, so at the deepest
     level the leaf g/h sums come for free from the histogram — no extra
     pass over the rows (which an MXU-hostile ``[2,R]·[R,n_leaf]`` scan
@@ -185,8 +186,11 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         split_ok = 0.5 * best_gain > gamma
         feat = jnp.where(split_ok, feat, 0)
         thr = jnp.where(split_ok, thr, B - 1)        # bins ≤ B-1 → all left
+        # XGBoost's reported split gain (0 for degenerate nodes) — kept in
+        # the tree arrays so importance_type="gain" costs nothing extra
+        split_gain = jnp.where(split_ok, 0.5 * best_gain, 0.0)
         if not with_child_sums:
-            return feat, thr
+            return feat, thr, split_gain
         N, F = g.shape[0], g.shape[1]
         n_idx = jnp.arange(N, dtype=jnp.int32)
         flat_idx = (n_idx * F + feat) * B + thr
@@ -196,7 +200,7 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         th_ = ch[:, 0, -1]
         child_g = jnp.stack([lg, tg - lg], axis=1).reshape(2 * N)
         child_h = jnp.stack([lh, th_ - lh], axis=1).reshape(2 * N)
-        return feat, thr, child_g, child_h
+        return feat, thr, split_gain, child_g, child_h
 
     return best_split
 
@@ -624,7 +628,7 @@ class HistGBT:
                     return pg["g"], pg["h"]
                 return pg["g"][:, col], pg["h"][:, col]
 
-            feats, thrs = [], []
+            feats, thrs, gains = [], [], []
             for level in range(depth):
                 n_nodes = 1 << level
                 hist = None
@@ -638,9 +642,10 @@ class HistGBT:
                 hist_np = np.asarray(hist)
                 if distributed:
                     hist_np = coll.allreduce(hist_np)  # cross-worker sync
-                feat, thr = best_split(jnp.asarray(hist_np), feat_mask)
+                feat, thr, gn = best_split(jnp.asarray(hist_np), feat_mask)
                 feats.append(np.pad(np.asarray(feat), (0, half - n_nodes)))
                 thrs.append(np.pad(np.asarray(thr), (0, half - n_nodes)))
+                gains.append(np.pad(np.asarray(gn), (0, half - n_nodes)))
                 for pg in pages:
                     pg["node"] = np.asarray(_advance_node(
                         jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
@@ -659,7 +664,7 @@ class HistGBT:
                 hsum = coll.allreduce(hsum)
             leaf = (-gsum / (hsum + p.reg_lambda) * p.learning_rate
                     ).astype(np.float32)
-            return np.stack(feats), np.stack(thrs), leaf
+            return np.stack(feats), np.stack(thrs), np.stack(gains), leaf
 
         t0 = get_time()
         for r in range(p.n_trees):
@@ -688,21 +693,23 @@ class HistGBT:
                     pg["g"] = np.where(k_col, pg["g"], 0.0)
                     pg["h"] = np.where(k_col, pg["h"], 0.0)
             if K_cls == 1:
-                feats, thrs, leaf = grow_one_tree(None, feat_mask)
+                feats, thrs, gains, leaf = grow_one_tree(None, feat_mask)
                 for pg in pages:
                     pg["preds"] = pg["preds"] + leaf[pg["node"]]
-                self.trees.append({"feat": feats, "thr": thrs, "leaf": leaf})
+                self.trees.append({"feat": feats, "thr": thrs,
+                                   "gain": gains, "leaf": leaf})
             else:
                 per_class = []
                 for c in range(K_cls):
-                    feats, thrs, leaf = grow_one_tree(c, feat_mask)
+                    feats, thrs, gains, leaf = grow_one_tree(c, feat_mask)
                     for pg in pages:
                         pg["preds"][:, c] += leaf[pg["node"]]
-                    per_class.append((feats, thrs, leaf))
+                    per_class.append((feats, thrs, gains, leaf))
                 self.trees.append({
                     "feat": np.stack([t[0] for t in per_class]),
                     "thr": np.stack([t[1] for t in per_class]),
-                    "leaf": np.stack([t[2] for t in per_class]),
+                    "gain": np.stack([t[2] for t in per_class]),
+                    "leaf": np.stack([t[3] for t in per_class]),
                 })
             if eval_every and (r + 1) % eval_every == 0:
                 # mean of per-row losses across ALL pages, then the
@@ -777,18 +784,21 @@ class HistGBT:
             node = jnp.zeros(bins_l.shape[0], jnp.int32)
             feats = []
             thrs = []
+            gains = []
             gsum = hsum = None
             for level in range(depth):
                 n_nodes = 1 << level
                 hist = build_histogram(bins_l, node, g, h, n_nodes, B, method)
                 hist = jax.lax.psum(hist, "data")
                 if level == depth - 1:
-                    feat, thr, gsum, hsum = best_split_leaf(hist, feat_mask)
+                    feat, thr, gn, gsum, hsum = best_split_leaf(hist,
+                                                                feat_mask)
                 else:
-                    feat, thr = best_split(hist, feat_mask)
+                    feat, thr, gn = best_split(hist, feat_mask)
                 # pad per-level arrays to a common width for stacking
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
+                gains.append(jnp.pad(gn, (0, half - n_nodes)))
                 # descend one level, gather-free: select each row's split
                 # feature value by compare-and-sum over the F columns
                 feat_sel = table_select(feat, node, n_nodes)          # [n]
@@ -802,6 +812,7 @@ class HistGBT:
             tree = {
                 "feat": jnp.stack(feats),                # [depth, half]
                 "thr": jnp.stack(thrs),
+                "gain": jnp.stack(gains),                # [depth, half]
                 "leaf": leaf,                            # [n_leaf]
             }
             return tree, table_select(leaf, node, n_leaf)
@@ -837,7 +848,7 @@ class HistGBT:
                 class_trees.append(tree_c)
                 deltas.append(delta_c)
             tree = {key_: jnp.stack([t[key_] for t in class_trees])
-                    for key_ in ("feat", "thr", "leaf")}  # [K, ...]
+                    for key_ in ("feat", "thr", "gain", "leaf")}  # [K, ...]
             return preds_l + jnp.stack(deltas, axis=1), tree
 
         preds_spec = P("data", None) if n_class > 1 else P("data")
@@ -1005,29 +1016,43 @@ class HistGBT:
         """Per-feature importance over the ensemble.
 
         ``"weight"``: number of real (non-degenerate, non-padding) splits
-        using each feature.  Degenerate/early-stopped nodes are written
-        with ``thr == n_bins-1`` and level padding with ``thr == 0`` past
-        the level's node count, so only genuine splits are counted.
+        using each feature; ``"gain"``: total split gain accumulated per
+        feature (XGBoost's default notion of importance).  Degenerate/
+        early-stopped nodes are written with ``thr == n_bins-1`` and
+        level padding with ``thr == 0`` past the level's node count, so
+        only genuine splits are counted.
         """
         CHECK(len(self.trees) > 0, "no trees trained")
-        if importance_type != "weight":
+        if importance_type not in ("weight", "gain"):
             log_fatal(f"unsupported importance_type {importance_type!r}")
+        if importance_type == "gain":
+            CHECK(all("gain" in t for t in self.trees),
+                  "importance_type='gain' needs trees with stored gains "
+                  "(models saved before gain tracking have none)")
         F = int(np.asarray(self.cuts).shape[0])
-        counts = np.zeros(F, np.int64)
+        out = np.zeros(F, np.float64 if importance_type == "gain"
+                       else np.int64)
         B = self.param.n_bins
         for tree in self.trees:
             feat_t = np.asarray(tree["feat"])
             thr_t = np.asarray(tree["thr"])
+            gain_t = (np.asarray(tree["gain"])
+                      if importance_type == "gain" else None)
             if feat_t.ndim == 2:            # single-output: [depth, half]
                 feat_t, thr_t = feat_t[None], thr_t[None]
-            for feat_c, thr_c in zip(feat_t, thr_t):   # per class tree
+                gain_t = None if gain_t is None else gain_t[None]
+            for c, (feat_c, thr_c) in enumerate(zip(feat_t, thr_t)):
                 for level in range(feat_c.shape[0]):
                     n_nodes = 1 << level
                     feat = feat_c[level][:n_nodes]
                     thr = thr_c[level][:n_nodes]
                     real = thr < B - 1      # degenerate splits use B-1
-                    np.add.at(counts, feat[real], 1)
-        return counts
+                    if importance_type == "gain":
+                        np.add.at(out, feat[real],
+                                  gain_t[c][level][:n_nodes][real])
+                    else:
+                        np.add.at(out, feat[real], 1)
+        return out
 
 
 @partial(jax.jit, static_argnums=(4,))

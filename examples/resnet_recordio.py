@@ -29,7 +29,9 @@ def write_shards(root, n_shards=2, per_shard=256, hw=32):
                 label = int(rng.integers(0, 10))
                 img = (rng.random((hw, hw, 3)) * 255).astype(np.uint8)
                 # class signal: channel 0 brightness tracks the label
-                img[..., 0] = np.clip(img[..., 0] // 4 + label * 25, 0, 255)
+                img[..., 0] = np.clip(
+                    img[..., 0].astype(np.int32) // 4 + label * 25,
+                    0, 255).astype(np.uint8)
                 w.write_record(pack_image_record(img, label))
 
 

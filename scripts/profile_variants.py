@@ -59,9 +59,9 @@ def make_round(with_hist=True, with_split=True, with_descend=True,
                 hist = jnp.zeros((2, n_nodes, F, B), jnp.float32) + g[0]
             if with_split:
                 if level == DEPTH - 1:
-                    feat, thr, gsum, hsum = best_split_leaf(hist)
+                    feat, thr, _gn, gsum, hsum = best_split_leaf(hist)
                 else:
-                    feat, thr = best_split(hist)
+                    feat, thr, _gn = best_split(hist)
             else:
                 feat = jnp.zeros(n_nodes, jnp.int32) + hist[0, 0, 0, 0].astype(jnp.int32) % F
                 thr = jnp.full(n_nodes, B // 2, jnp.int32)

@@ -551,3 +551,37 @@ class TestEvalMetrics:
             HistGBT(eval_metric="merror")          # binary obj, multi metric
         with pytest.raises(Error):
             HistGBT(objective="reg:squarederror", eval_metric="auc")
+
+
+def test_gain_importance():
+    X, y = _synthetic(n=4000, f=6)
+    m = HistGBT(n_trees=12, max_depth=4, n_bins=32, learning_rate=0.5)
+    m.fit(X, y)
+    w = m.feature_importances("weight")
+    g = m.feature_importances("gain")
+    assert g.shape == (6,)
+    assert (g >= 0).all() and g.sum() > 0
+    # informative features (0..3 in _synthetic's margin) dominate by gain
+    assert g[:4].sum() > g[4:].sum()
+    # trees carry gains; weight importance unchanged by the addition
+    assert all("gain" in t for t in m.trees)
+    assert w.sum() > 0
+
+
+def test_gain_importance_multiclass(tmp_path):
+    rng = np.random.default_rng(0)
+    K = 3
+    centers = np.random.default_rng(42).normal(scale=3.0, size=(K, 2))
+    yl = rng.integers(0, K, 3000)
+    X = rng.normal(size=(3000, 5)).astype(np.float32)
+    X[:, :2] += centers[yl]
+    m = HistGBT(n_trees=6, max_depth=3, n_bins=32,
+                objective="multi:softmax", num_class=K)
+    m.fit(X, yl.astype(np.float32))
+    g = m.feature_importances("gain")
+    assert g[:2].sum() > g[2:].sum()
+    # survives save/load
+    uri = str(tmp_path / "g.bin")
+    m.save_model(uri)
+    g2 = HistGBT.load_model(uri).feature_importances("gain")
+    np.testing.assert_allclose(g2, g)
