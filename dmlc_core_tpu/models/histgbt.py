@@ -889,25 +889,35 @@ class HistGBT:
     # ------------------------------------------------------------------
     # inference
     # ------------------------------------------------------------------
+    #: rows per device batch in predict — bounds the transient f32 X and
+    #: bin matrices on device regardless of input size (Criteo-scale
+    #: scoring must not need training-scale memory)
+    _PREDICT_BATCH = 2_000_000
+
     def predict(self, X: np.ndarray, output_margin: bool = False,
                 n_trees: Optional[int] = None) -> np.ndarray:
         CHECK(self.cuts is not None, "predict before fit")
         CHECK(len(self.trees) > 0, "no trees trained")
         p = self.param
         X = np.ascontiguousarray(X, dtype=np.float32)
-        bins = apply_bins(jnp.asarray(X), self.cuts)
         if n_trees is None and getattr(self, "_early_stopped", False) \
                 and self.best_iteration is not None:
             n_trees = self.best_iteration + 1   # XGBoost early-stop default
         use = self.trees if n_trees is None else self.trees[:n_trees]
         stacked = self._stacked_trees(use)
-        margin = self._apply_trees(
-            bins, stacked,
-            jnp.full(self._margin_shape(bins.shape[0]), p.base_score,
-                     jnp.float32))
-        if output_margin:
-            return np.asarray(margin)
-        return np.asarray(self._obj.transform(margin))
+        if len(X) == 0:
+            return np.zeros(self._margin_shape(0), np.float32)
+        outs = []
+        for lo in range(0, len(X), self._PREDICT_BATCH):
+            xb = X[lo:lo + self._PREDICT_BATCH]
+            bins = apply_bins(jnp.asarray(xb), self.cuts)
+            margin = self._apply_trees(
+                bins, stacked,
+                jnp.full(self._margin_shape(len(xb)), p.base_score,
+                         jnp.float32))
+            outs.append(np.asarray(
+                margin if output_margin else self._obj.transform(margin)))
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def predict_proba(self, X: np.ndarray,
                       n_trees: Optional[int] = None) -> np.ndarray:

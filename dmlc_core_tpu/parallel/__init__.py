@@ -31,3 +31,4 @@ from dmlc_core_tpu.parallel.kvstore import KVStore  # noqa: F401
 from dmlc_core_tpu.parallel.ring_attention import (  # noqa: F401
     reference_attention, ring_attention)
 from dmlc_core_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+from dmlc_core_tpu.parallel.zero import ZeroAdam, ZeroState  # noqa: F401

@@ -1,0 +1,95 @@
+"""ZeRO-style sharded optimizer state over the mesh's data axis.
+
+The PS-replacement promised in SURVEY.md §2e: instead of a parameter
+server holding optimizer state (the reference world's ps-lite role),
+each data-parallel worker owns 1/P of every parameter's optimizer state:
+
+* backward produces per-shard gradients (summed over local batches);
+* ``psum_scatter`` reduces them across the axis while leaving each
+  device ONLY its 1/P slice (half an allreduce's bandwidth);
+* the optimizer update (here Adam) runs on the slice — P× less state
+  and update compute per device;
+* one ``all_gather`` rebuilds the full parameter for the next forward.
+
+Designed for use INSIDE ``shard_map`` (axis collectives), composing with
+the same mesh the models train on.  ``shard/unshard`` handle padding so
+any parameter size works on any axis size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ZeroAdam", "ZeroState"]
+
+
+class ZeroState(NamedTuple):
+    """Per-device optimizer shard: first/second moments + step count."""
+    mu: Any       # pytree of [ceil(size/P)] f32 slices
+    nu: Any
+    count: jax.Array
+
+
+def _flat_pad(x: jax.Array, P: int) -> jax.Array:
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % P
+    return jnp.pad(flat, (0, pad))
+
+
+class ZeroAdam:
+    """Adam with parameters replicated but optimizer state sharded 1/P.
+
+    All methods must run inside a ``shard_map`` over ``axis``:
+
+    >>> opt = ZeroAdam(lr=1e-3)
+    >>> state = opt.init(params)                    # per-device shards
+    >>> params, state = opt.step(params, grads, state)  # psum_scatter+gather
+    """
+
+    def __init__(self, lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, axis: str = "data"):
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+        self.axis = axis
+
+    def init(self, params: Dict[str, jax.Array]) -> ZeroState:
+        P = lax.psum(1, self.axis)
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(_flat_pad(p, P).shape[0] // P, jnp.float32),
+            params)
+        return ZeroState(mu=zeros, nu=jax.tree.map(jnp.copy, zeros),
+                         count=jnp.zeros((), jnp.int32))
+
+    def step(self, params, grads, state: ZeroState):
+        """One update.  ``grads`` are this device's local gradients (e.g.
+        from its batch shard); the reduce happens in here."""
+        P = lax.psum(1, self.axis)
+        count = state.count + 1
+        b1c = 1.0 - self.b1 ** count.astype(jnp.float32)
+        b2c = 1.0 - self.b2 ** count.astype(jnp.float32)
+
+        def upd(p, g, mu, nu):
+            flat_g = _flat_pad(g, P)
+            # mean-reduce across workers, keep only my 1/P slice
+            g_slice = lax.psum_scatter(flat_g, self.axis, tiled=True) / P
+            mu2 = self.b1 * mu + (1 - self.b1) * g_slice
+            nu2 = self.b2 * nu + (1 - self.b2) * g_slice * g_slice
+            delta = (self.lr * (mu2 / b1c)
+                     / (jnp.sqrt(nu2 / b2c) + self.eps))
+            # rebuild the full parameter delta for the replicated params
+            full = lax.all_gather(delta, self.axis, tiled=True)
+            p2 = p - full[: p.size].reshape(p.shape)
+            return p2, mu2, nu2
+
+        # tree.map (like init) so arbitrarily nested param pytrees work
+        triples = jax.tree.map(upd, params, grads, state.mu, state.nu)
+        params2 = jax.tree.map(lambda t: t[0], triples,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        mu2 = jax.tree.map(lambda t: t[1], triples,
+                           is_leaf=lambda x: isinstance(x, tuple))
+        nu2 = jax.tree.map(lambda t: t[2], triples,
+                           is_leaf=lambda x: isinstance(x, tuple))
+        return params2, ZeroState(mu=mu2, nu=nu2, count=count)

@@ -585,3 +585,20 @@ def test_gain_importance_multiclass(tmp_path):
     m.save_model(uri)
     g2 = HistGBT.load_model(uri).feature_importances("gain")
     np.testing.assert_allclose(g2, g)
+
+
+def test_predict_batching_consistent(monkeypatch):
+    X, y = _synthetic(n=5000, f=5)
+    m = HistGBT(n_trees=5, max_depth=3, n_bins=32)
+    m.fit(X, y)
+    whole = m.predict(X, output_margin=True)
+    monkeypatch.setattr(HistGBT, "_PREDICT_BATCH", 1234)  # force 5 batches
+    batched = m.predict(X, output_margin=True)
+    np.testing.assert_array_equal(whole, batched)
+
+
+def test_predict_empty_input():
+    X, y = _synthetic(n=500, f=4)
+    m = HistGBT(n_trees=2, max_depth=2, n_bins=16)
+    m.fit(X, y)
+    assert m.predict(np.zeros((0, 4), np.float32)).shape == (0,)
