@@ -138,13 +138,21 @@ class _SquaredError(_ObjectiveBase):
 
 
 def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
-                     with_child_sums: bool = False):
+                     with_child_sums: bool = False,
+                     mono: Optional[np.ndarray] = None):
     """Greedy per-node split chooser over a gradient histogram.
 
     hist [2,N,F,B] → (feat [N], thr [N], split_gain [N]); degenerate
     split (feat 0, thr B-1 → everyone left, gain 0) when gain ≤ gamma.
     Shared by the in-core shard_map round and the external-memory page
     loop.
+
+    ``mono`` ([F] ints ∈ {-1, 0, +1}) enables monotone constraints: a
+    candidate split on a constrained feature whose (bound-clipped)
+    optimal child weights violate the required ordering gets gain −inf;
+    the caller passes each node's inherited weight ``bounds`` [N, 2] and
+    propagates them down (see ``grow_tree``), which together with leaf
+    clipping makes the trained function globally monotone.
 
     ``with_child_sums=True`` additionally returns the children's
     ``(g_sum, h_sum)`` as ``[2N]`` arrays (leaf order: left=2i,
@@ -162,7 +170,7 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
     the deliberate price of eliminating the dominant per-round pass.
     """
 
-    def best_split(hist, feat_mask=None):
+    def best_split(hist, feat_mask=None, bounds=None):
         g = hist[0]
         h = hist[1]
         cg = jnp.cumsum(g, axis=-1)                  # [N,F,B] left-incl. sums
@@ -174,6 +182,31 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         gr = gt - gl
         hr = ht - hl
         gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam) - gt**2 / (ht + lam))
+        if mono is not None:
+            # bounds bind the REALIZABLE child weights, so gain must be
+            # evaluated at the clipped weights (XGBoost's constrained
+            # gain) — the closed form above assumes unclipped optima and
+            # would rank clipped splits by value they cannot achieve.
+            # For (-inf, inf) bounds this reduces exactly to the closed
+            # form: obj(w*) = -G²/2(H+λ), gain = 2·Δobj.
+            wl = -gl / (hl + lam)                    # candidate child weights
+            wr = -gr / (hr + lam)
+            wp = -gt / (ht + lam)
+            if bounds is not None:                   # inherited node bounds
+                lo = bounds[:, 0][:, None, None]
+                hi = bounds[:, 1][:, None, None]
+                wl = jnp.clip(wl, lo, hi)
+                wr = jnp.clip(wr, lo, hi)
+                wp = jnp.clip(wp, lo, hi)
+
+            def objv(G, H, w):
+                return G * w + 0.5 * (H + lam) * w * w
+
+            gain = 2.0 * (objv(gt, ht, wp) - objv(gl, hl, wl)
+                          - objv(gr, hr, wr))
+            m = jnp.asarray(mono)[None, :, None]     # [1, F, 1]
+            viol = ((m > 0) & (wl > wr)) | ((m < 0) & (wl < wr))
+            gain = jnp.where(viol, -jnp.inf, gain)
         ok = (hl >= mcw) & (hr >= mcw)
         gain = jnp.where(ok, gain, -jnp.inf)
         if feat_mask is not None:                    # colsample: [F] bool
@@ -290,6 +323,9 @@ class HistGBTParam(Parameter):
                         enum=[""] + sorted(EVAL_METRICS),
                         description="validation metric (default: the "
                                     "objective's own)")
+    monotone_constraints = field(list, default=(),
+                                 description="per-feature -1/0/+1 monotone "
+                                             "constraints (empty = none)")
     hist_method = field(str, default="auto",
                         enum=["auto", "segment", "matmul", "pallas"],
                         description="histogram engine (ops.histogram)")
@@ -378,6 +414,13 @@ class HistGBT:
         if p.num_class > 1:
             CHECK(y.min() >= 0 and y.max() < p.num_class,
                   f"multi:softmax labels must be in [0, {p.num_class})")
+        if p.monotone_constraints:
+            CHECK_EQ(len(p.monotone_constraints), F,
+                     "monotone_constraints length must equal n_features")
+            # strict membership: 0.5 or "x" must be rejected, not silently
+            # truncated to "no constraint" by an int() cast
+            CHECK(all(v in (-1, 0, 1) for v in p.monotone_constraints),
+                  "monotone_constraints values must be -1, 0 or +1")
 
         # continued training (xgb_model semantics): keep the existing bin
         # boundaries — the loaded trees' thresholds are only meaningful
@@ -561,6 +604,9 @@ class HistGBT:
         from dmlc_core_tpu.parallel import collectives as coll
 
         p = self.param
+        CHECK(not (p.monotone_constraints
+                   and any(int(v) for v in p.monotone_constraints)),
+              "fit_external: monotone_constraints not supported — use fit()")
         B = p.n_bins
         depth = p.max_depth
         n_leaf = 1 << depth
@@ -740,9 +786,16 @@ class HistGBT:
         n_leaf = 1 << depth
         half = max(n_leaf >> 1, 1)
 
-        best_split = _make_best_split(B, lam, gamma, mcw)
+        mono_arr = None
+        if p.monotone_constraints:
+            mc = np.asarray([int(v) for v in p.monotone_constraints],
+                            np.int32)
+            if np.any(mc):
+                mono_arr = mc
+        best_split = _make_best_split(B, lam, gamma, mcw, mono=mono_arr)
         best_split_leaf = _make_best_split(B, lam, gamma, mcw,
-                                           with_child_sums=True)
+                                           with_child_sums=True,
+                                           mono=mono_arr)
         sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
 
         def table_select(table, node, n_entries):
@@ -780,19 +833,29 @@ class HistGBT:
 
             The per-level histogram is psum'd over the data axis (THE
             histogram-sync allreduce); leaf g/h sums come free from the
-            deepest level's cumsum."""
+            deepest level's cumsum.  With monotone constraints, every
+            level additionally gets the chosen split's child sums so
+            each node's weight bounds propagate down (child bound =
+            midpoint of the clipped child weights, XGBoost-style) and
+            the final leaf weights are clipped into their bounds."""
             node = jnp.zeros(bins_l.shape[0], jnp.int32)
             feats = []
             thrs = []
             gains = []
             gsum = hsum = None
+            bounds = None
+            if mono_arr is not None:
+                bounds = jnp.stack([jnp.full(1, -jnp.inf, jnp.float32),
+                                    jnp.full(1, jnp.inf, jnp.float32)], 1)
             for level in range(depth):
                 n_nodes = 1 << level
                 hist = build_histogram(bins_l, node, g, h, n_nodes, B, method)
                 hist = jax.lax.psum(hist, "data")
-                if level == depth - 1:
-                    feat, thr, gn, gsum, hsum = best_split_leaf(hist,
-                                                                feat_mask)
+                if mono_arr is not None or level == depth - 1:
+                    feat, thr, gn, cg_, ch_ = best_split_leaf(
+                        hist, feat_mask, bounds)
+                    if level == depth - 1:
+                        gsum, hsum = cg_, ch_
                 else:
                     feat, thr, gn = best_split(hist, feat_mask)
                 # pad per-level arrays to a common width for stacking
@@ -808,7 +871,30 @@ class HistGBT:
                     jnp.where(feat_sel[:, None] == f_iota,
                               bins_l.astype(jnp.int32), 0), axis=1)   # [n]
                 node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
-            leaf = -gsum / (hsum + lam) * eta
+                if mono_arr is not None:
+                    lo, hi = bounds[:, 0], bounds[:, 1]               # [N]
+                    w_child = jnp.clip(
+                        (-cg_ / (ch_ + lam)).reshape(n_nodes, 2),
+                        lo[:, None], hi[:, None])
+                    mid = w_child.mean(axis=1)                        # [N]
+                    c = jnp.asarray(mono_arr)[feat]                   # [N]
+                    real = thr < B - 1           # degenerate splits inert
+                    up_l = jnp.where((c > 0) & real,
+                                     jnp.minimum(hi, mid), hi)
+                    lo_r = jnp.where((c > 0) & real,
+                                     jnp.maximum(lo, mid), lo)
+                    lo_l = jnp.where((c < 0) & real,
+                                     jnp.maximum(lo, mid), lo)
+                    up_r = jnp.where((c < 0) & real,
+                                     jnp.minimum(hi, mid), hi)
+                    bounds = jnp.stack([
+                        jnp.stack([lo_l, up_l], 1),
+                        jnp.stack([lo_r, up_r], 1)], axis=1
+                    ).reshape(2 * n_nodes, 2)
+            leaf_w = -gsum / (hsum + lam)
+            if mono_arr is not None:
+                leaf_w = jnp.clip(leaf_w, bounds[:, 0], bounds[:, 1])
+            leaf = leaf_w * eta
             tree = {
                 "feat": jnp.stack(feats),                # [depth, half]
                 "thr": jnp.stack(thrs),
