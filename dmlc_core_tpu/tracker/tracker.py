@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
 from dmlc_core_tpu.parallel.collectives import get_link_map
 
-__all__ = ["RabitTracker", "PSTracker", "submit"]
+__all__ = ["RabitTracker", "WorkerSession", "PSTracker", "submit"]
 
 
 class RabitTracker:
@@ -45,6 +45,12 @@ class RabitTracker:
         self._done = threading.Event()
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        # Liveness bookkeeping (reference holds worker connections open for
+        # the whole job, so a dying worker is observable; same here for
+        # workers that handshake with persistent=True via WorkerSession).
+        self._alive: Dict[int, socket.socket] = {}   # rank -> live conn
+        self._free_ranks: List[int] = []             # ranks freed by death
+        self.dead_workers: List[int] = []            # death history (ranks)
 
     # -- env ABI ---------------------------------------------------------
     def slave_envs(self) -> Dict[str, str]:
@@ -72,27 +78,70 @@ class RabitTracker:
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        """Serve one worker connection until it closes.
+
+        The connection is held open (the reference's tracker keeps one
+        socket per worker for the job's lifetime): a worker may send any
+        number of commands as JSON lines.  If the worker handshook with
+        ``persistent: true`` and the socket closes before it sent
+        ``shutdown``, the tracker records the death, logs it, and frees the
+        rank for a replacement worker (``start`` reuses freed ranks).
+        """
+        state: Dict[str, Any] = {"rank": -1, "persistent": False, "clean": False}
         try:
             with conn:
                 buf = b""
-                while b"\n" not in buf:
-                    data = conn.recv(4096)
-                    if not data:
+                while not self._done.is_set():
+                    while b"\n" not in buf:
+                        data = conn.recv(4096)
+                        if not data:
+                            raise ConnectionResetError  # EOF → liveness check below
+                        buf += data
+                    line, buf = buf.split(b"\n", 1)
+                    try:
+                        msg = json.loads(line)
+                    except json.JSONDecodeError as e:
+                        # a garbled line is not a death certificate: skip it
+                        LOG("WARNING", "tracker: bad worker message: %s", e)
+                        continue
+                    reply = self._handle(msg, conn, state)
+                    if reply is not None:
+                        conn.sendall(json.dumps(reply).encode() + b"\n")
+                    if state["clean"]:
                         return
-                    buf += data
-                msg = json.loads(buf.split(b"\n", 1)[0])
-                reply = self._handle(msg)
-                if reply is not None:
-                    conn.sendall(json.dumps(reply).encode() + b"\n")
-        except (json.JSONDecodeError, OSError) as e:
-            LOG("WARNING", "tracker: bad worker message: %s", e)
+        except (ConnectionResetError, OSError):
+            pass
+        finally:
+            self._on_disconnect(state)
 
-    def _handle(self, msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    def _on_disconnect(self, state: Dict[str, Any]) -> None:
+        rank = state["rank"]
+        if rank < 0 or not state["persistent"]:
+            return  # one-shot legacy connection: close is not a death signal
+        with self._lock:
+            if self._alive.pop(rank, None) is None:
+                return
+            if not state["clean"]:
+                self.dead_workers.append(rank)
+                self._free_ranks.append(rank)
+                LOG("WARNING", "tracker: worker rank %d died (socket closed "
+                    "without shutdown); rank freed for recovery", rank)
+
+    def alive_ranks(self) -> List[int]:
+        """Ranks with a live persistent connection right now."""
+        with self._lock:
+            return sorted(self._alive)
+
+    def _handle(self, msg: Dict[str, Any], conn: Optional[socket.socket] = None,
+                state: Optional[Dict[str, Any]] = None) -> Optional[Dict[str, Any]]:
+        state = state if state is not None else {"rank": -1, "persistent": False,
+                                                 "clean": False}
         cmd = msg.get("cmd")
         if cmd == "print":
             LOG("INFO", "worker: %s", msg.get("msg", ""))
             return None
         if cmd == "shutdown":
+            state["clean"] = True
             with self._lock:
                 self._shutdown_count += 1
                 if self._shutdown_count >= self.nworker:
@@ -104,11 +153,22 @@ class RabitTracker:
                     rank = int(msg["rank"])  # rejoining worker keeps its rank
                 elif msg.get("host") and msg["host"] in self._host_rank and cmd == "recover":
                     rank = self._host_rank[msg["host"]]
+                elif self._free_ranks:
+                    rank = self._free_ranks.pop(0)  # replace a dead worker
                 else:
                     rank = self._next_rank
                     self._next_rank += 1
-                    if msg.get("host"):
-                        self._host_rank[msg["host"]] = rank
+                # the rank is now owned by this worker alone: it must not be
+                # handed out again via the free list or a stale host mapping
+                if rank in self._free_ranks:
+                    self._free_ranks.remove(rank)
+                for h in [h for h, r in self._host_rank.items() if r == rank]:
+                    del self._host_rank[h]
+                if msg.get("host"):
+                    self._host_rank[msg["host"]] = rank
+                if rank < self.nworker and msg.get("persistent") and conn is not None:
+                    state["rank"], state["persistent"] = rank, True
+                    self._alive[rank] = conn
             if rank >= self.nworker:
                 return {"error": f"too many workers (nworker={self.nworker})"}
             link = self._links[rank]
@@ -122,12 +182,25 @@ class RabitTracker:
             }
         return {"error": f"unknown cmd {cmd!r}"}
 
-    def join(self, timeout: Optional[float] = None) -> None:
-        """Block until all workers sent 'shutdown'."""
-        self._done.wait(timeout)
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Block until all workers sent 'shutdown'.
+
+        Returns ``True`` if the job completed (all shutdowns received)
+        within ``timeout``, ``False`` on timeout — so a partial shutdown
+        (hung or dead worker) is observable instead of hanging forever.
+        """
+        return self._done.wait(timeout)
 
     def stop(self) -> None:
         self._done.set()
+        with self._lock:
+            conns = list(self._alive.values())
+            self._alive.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
         try:
             self._sock.close()
         except OSError:
@@ -147,6 +220,62 @@ class RabitTracker:
                     log_fatal("tracker connection closed mid-handshake")
                 buf += data
         return json.loads(buf.split(b"\n", 1)[0])
+
+
+class WorkerSession:
+    """Persistent worker-side connection to a :class:`RabitTracker`.
+
+    Unlike :meth:`RabitTracker.worker_connect` (one-shot, legacy), a
+    WorkerSession keeps its socket open for the whole job — mirroring how
+    the reference's workers held their tracker socket — which is what makes
+    dead-worker detection possible: if this process dies, the tracker sees
+    the socket close without a ``shutdown`` and frees the rank.
+
+    Usage::
+
+        with WorkerSession(uri, port, host="node1") as ws:
+            rank = ws.info["rank"]
+            ...
+            ws.shutdown()   # clean exit; omitting it == abnormal death
+    """
+
+    def __init__(self, uri: str, port: int, cmd: str = "start",
+                 host: str = "", rank: int = -1):
+        self._sock = socket.create_connection((uri, port), timeout=30)
+        self.info = self._request({"cmd": cmd, "host": host, "rank": rank,
+                                   "persistent": True})
+        if "error" in self.info:
+            self._sock.close()
+            log_fatal("tracker rejected worker: %s" % self.info["error"])
+
+    def _request(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        self._sock.sendall(json.dumps(msg).encode() + b"\n")
+        buf = b""
+        while b"\n" not in buf:
+            data = self._sock.recv(4096)
+            if not data:
+                log_fatal("tracker connection closed mid-request")
+            buf += data
+        return json.loads(buf.split(b"\n", 1)[0])
+
+    def print_msg(self, text: str) -> None:
+        self._sock.sendall(json.dumps({"cmd": "print", "msg": text}).encode() + b"\n")
+
+    def shutdown(self) -> None:
+        self._request({"cmd": "shutdown"})
+        self.close()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "WorkerSession":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
 
 
 class PSTracker:
