@@ -21,13 +21,16 @@ hist on HIGGS, 8-way data-parallel).  Functional parity targets XGBoost's
   rows (bins, labels, preds) stay sharded on device across rounds, only
   O(2^depth) tree arrays come back to host.
 
-Sibling-subtraction (derive one child's histogram from parent − sibling)
-is a known 2× on the hist cost, deliberately not yet implemented — tracked
-as a perf follow-up.
+Sibling-subtraction (build only left children, derive right = parent −
+left from the previous level's synced histogram) halves both the one-hot
+matmul height and the per-level psum bytes; combined with the subtile-
+packed Pallas kernel (ops/histogram.py) a depth-6 tree's histogram work
+is ~1 full MXU row-pass instead of 6.
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -42,7 +45,8 @@ from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG, log_fatal
 from dmlc_core_tpu.base.parameter import Parameter, field
 from dmlc_core_tpu.base.registry import Registry
 from dmlc_core_tpu.base.timer import get_time
-from dmlc_core_tpu.ops.histogram import build_histogram
+from dmlc_core_tpu.ops.histogram import (build_histogram,
+                                         fused_descend_histogram)
 from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts
 from dmlc_core_tpu.parallel.mesh import local_mesh
 
@@ -447,6 +451,19 @@ class HistGBT:
         row_sharding = NamedSharding(self.mesh, P("data"))
         mat_sharding = NamedSharding(self.mesh, P("data", None))
         bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
+        # the round program wants bins FEATURE-major ([F, n], rows on
+        # lanes): the Pallas histogram kernel then reads its native
+        # layout directly instead of re-transposing the matrix inside
+        # every boosting round (a full HBM round-trip per round)
+        bins_t = jax.jit(
+            lambda b: b.T,
+            out_shardings=NamedSharding(self.mesh, P(None, "data")))(bins)
+        if not continuing:
+            # only the warm-start branch below reads the row-major copy;
+            # otherwise drop it now — keeping both layouts would double
+            # the binned matrix's HBM residency for the whole fit
+            bins.delete()
+            del bins
         y_d = jax.device_put(y, row_sharding)
         w_d = jax.device_put(mask, row_sharding)
         K_cls = p.num_class
@@ -457,6 +474,8 @@ class HistGBT:
                 bins, self._stacked_trees(self.trees),
                 jnp.full(margin_shape, p.base_score, jnp.float32))
             ).astype(np.float32)
+            bins.delete()
+            del bins
         preds = jax.device_put(
             init_margin,
             mat_sharding if K_cls > 1 else row_sharding)
@@ -480,9 +499,9 @@ class HistGBT:
                 # chunk key derives from the round index so a given round
                 # draws the same sample no matter how rounds are chunked
                 # into dispatches within a fixed K
-                return fn(bins, y_d, w_d, preds_c,
+                return fn(bins_t, y_d, w_d, preds_c,
                           jax.random.fold_in(base_key, done))
-            return fn(bins, y_d, w_d, preds_c)
+            return fn(bins_t, y_d, w_d, preds_c)
 
         kfn = self._build_round_fn(F, K)
         rem = p.n_trees % K
@@ -675,19 +694,32 @@ class HistGBT:
                 return pg["g"][:, col], pg["h"][:, col]
 
             feats, thrs, gains = [], [], []
+            prev_hist = None
             for level in range(depth):
+                # sibling subtraction (same as grow_tree): below the root
+                # build only left children, derive right = parent − left
                 n_nodes = 1 << level
+                n_build = 1 if level == 0 else n_nodes >> 1
                 hist = None
                 for pg in pages:
                     g_c, h_c = gh(pg)
+                    nd = jnp.asarray(pg["node"])
+                    if level > 0:
+                        nd = jnp.where((nd >= 0) & (nd % 2 == 0),
+                                       nd >> 1, -1)
                     ph = build_histogram(
-                        jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
+                        jnp.asarray(pg["bins"]), nd,
                         jnp.asarray(g_c), jnp.asarray(h_c),
-                        n_nodes, B, p.hist_method)
+                        n_build, B, p.hist_method)
                     hist = ph if hist is None else hist + ph
                 hist_np = np.asarray(hist)
                 if distributed:
                     hist_np = coll.allreduce(hist_np)  # cross-worker sync
+                if level > 0:
+                    hist_np = np.stack(
+                        [hist_np, prev_hist - hist_np], axis=2).reshape(
+                        2, n_nodes, hist_np.shape[2], B)
+                prev_hist = hist_np
                 feat, thr, gn = best_split(jnp.asarray(hist_np), feat_mask)
                 feats.append(np.pad(np.asarray(feat), (0, half - n_nodes)))
                 thrs.append(np.pad(np.asarray(thr), (0, half - n_nodes)))
@@ -797,6 +829,10 @@ class HistGBT:
                                            with_child_sums=True,
                                            mono=mono_arr)
         sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
+        # two-pass descend+hist measured faster than the fused kernel on
+        # v5e (see ops.fused_descend_histogram); env knob for other HW
+        fuse_levels = bool(int(
+            os.environ.get("DMLC_TPU_FUSED_DESCEND", "0")))
 
         def table_select(table, node, n_entries):
             """Gather-free ``table[node]`` for a tiny per-node table: a
@@ -828,7 +864,7 @@ class HistGBT:
                 feat_mask = scores <= kth
             return keep, feat_mask
 
-        def grow_tree(bins_l, g, h, feat_mask):
+        def grow_tree(bins_tl, g, h, feat_mask):
             """One level-wise tree on (g, h) → (tree arrays, margin delta).
 
             The per-level histogram is psum'd over the data axis (THE
@@ -837,20 +873,46 @@ class HistGBT:
             level additionally gets the chosen split's child sums so
             each node's weight bounds propagate down (child bound =
             midpoint of the clipped child weights, XGBoost-style) and
-            the final leaf weights are clipped into their bounds."""
-            node = jnp.zeros(bins_l.shape[0], jnp.int32)
+            the final leaf weights are clipped into their bounds.
+
+            Sibling subtraction: below the root only LEFT children get a
+            built histogram (right-child rows one-hot to nothing); the
+            right child is parent − left from the previous level's
+            already-synced histogram.  Halves the one-hot matmul height
+            AND the psum bytes per level, and the subtraction itself is
+            exact in f32 up to one rounding.  The descend into level ℓ
+            is FUSED into level ℓ's histogram kernel
+            (ops.fused_descend_histogram) — the bin tile is read from
+            HBM once per level instead of twice."""
+            node = jnp.zeros(bins_tl.shape[1], jnp.int32)
             feats = []
             thrs = []
             gains = []
             gsum = hsum = None
+            prev_hist = None
+            feat = thr = None
             bounds = None
             if mono_arr is not None:
                 bounds = jnp.stack([jnp.full(1, -jnp.inf, jnp.float32),
                                     jnp.full(1, jnp.inf, jnp.float32)], 1)
             for level in range(depth):
                 n_nodes = 1 << level
-                hist = build_histogram(bins_l, node, g, h, n_nodes, B, method)
-                hist = jax.lax.psum(hist, "data")
+                if level == 0:
+                    hist = build_histogram(bins_tl, node, g, h, 1, B,
+                                           method, transposed=True)
+                    hist = jax.lax.psum(hist, "data")
+                else:
+                    n_prev = n_nodes >> 1
+                    feat_sel = table_select(feat, node, n_prev)       # [n]
+                    thr_sel = table_select(thr, node, n_prev)         # [n]
+                    left, node = fused_descend_histogram(
+                        bins_tl, node, feat_sel, thr_sel, g, h,
+                        n_prev, B, method, fuse=fuse_levels)
+                    left = jax.lax.psum(left, "data")
+                    right = prev_hist - left
+                    hist = jnp.stack([left, right], axis=2).reshape(
+                        2, n_nodes, left.shape[2], B)
+                prev_hist = hist
                 if mono_arr is not None or level == depth - 1:
                     feat, thr, gn, cg_, ch_ = best_split_leaf(
                         hist, feat_mask, bounds)
@@ -862,15 +924,6 @@ class HistGBT:
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
                 gains.append(jnp.pad(gn, (0, half - n_nodes)))
-                # descend one level, gather-free: select each row's split
-                # feature value by compare-and-sum over the F columns
-                feat_sel = table_select(feat, node, n_nodes)          # [n]
-                thr_sel = table_select(thr, node, n_nodes)            # [n]
-                f_iota = jnp.arange(bins_l.shape[1], dtype=jnp.int32)[None, :]
-                row_bin = jnp.sum(
-                    jnp.where(feat_sel[:, None] == f_iota,
-                              bins_l.astype(jnp.int32), 0), axis=1)   # [n]
-                node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
                 if mono_arr is not None:
                     lo, hi = bounds[:, 0], bounds[:, 1]               # [N]
                     w_child = jnp.clip(
@@ -891,6 +944,17 @@ class HistGBT:
                         jnp.stack([lo_l, up_l], 1),
                         jnp.stack([lo_r, up_r], 1)], axis=1
                     ).reshape(2 * n_nodes, 2)
+            # final descend (the loop's fused kernels advanced node only
+            # up to level depth-1): select each row's split feature value
+            # gather-free by compare-and-sum over the F rows of bins_tl
+            feat_sel = table_select(feat, node, 1 << (depth - 1))
+            thr_sel = table_select(thr, node, 1 << (depth - 1))
+            f_iota = jnp.arange(bins_tl.shape[0],
+                                dtype=jnp.int32)[:, None]             # [F, 1]
+            row_bin = jnp.sum(
+                jnp.where(feat_sel[None, :] == f_iota,
+                          bins_tl.astype(jnp.int32), 0), axis=0)      # [n]
+            node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
             leaf_w = -gsum / (hsum + lam)
             if mono_arr is not None:
                 leaf_w = jnp.clip(leaf_w, bounds[:, 0], bounds[:, 1])
@@ -905,7 +969,7 @@ class HistGBT:
 
         n_class = p.num_class
 
-        def round_body(bins_l, y_l, w_l, preds_l, key=None):
+        def round_body(bins_tl, y_l, w_l, preds_l, key=None):
             keep = feat_mask = None
             if sampling:
                 keep, feat_mask = sample_masks(key, y_l.shape)
@@ -916,7 +980,7 @@ class HistGBT:
                 if keep is not None:
                     g = jnp.where(keep, g, 0.0)
                     h = jnp.where(keep, h, 0.0)
-                tree, delta = grow_tree(bins_l, g, h, feat_mask)
+                tree, delta = grow_tree(bins_tl, g, h, feat_mask)
                 return preds_l + delta, tree
             # multiclass: preds_l [n, K]; one tree per class per round,
             # built on the full-softmax gradients (XGBoost multi:softmax)
@@ -930,7 +994,7 @@ class HistGBT:
             deltas = []
             for c in range(n_class):
                 tree_c, delta_c = grow_tree(
-                    bins_l, g_all[:, c], h_all[:, c], feat_mask)
+                    bins_tl, g_all[:, c], h_all[:, c], feat_mask)
                 class_trees.append(tree_c)
                 deltas.append(delta_c)
             tree = {key_: jnp.stack([t[key_] for t in class_trees])
@@ -939,11 +1003,11 @@ class HistGBT:
 
         preds_spec = P("data", None) if n_class > 1 else P("data")
         if sampling:
-            def k_rounds_body(bins_l, y_l, w_l, preds_l, key):
+            def k_rounds_body(bins_tl, y_l, w_l, preds_l, key):
                 def step(carry, _):
                     preds_c, key_c = carry
                     key_c, key_r = jax.random.split(key_c)
-                    preds2, tree = round_body(bins_l, y_l, w_l, preds_c,
+                    preds2, tree = round_body(bins_tl, y_l, w_l, preds_c,
                                               key_r)
                     return (preds2, key_c), tree
 
@@ -951,16 +1015,16 @@ class HistGBT:
                     step, (preds_l, key), None, length=n_rounds)
                 return preds_out, trees
 
-            in_specs = (P("data", None), P("data"), P("data"), preds_spec,
+            in_specs = (P(None, "data"), P("data"), P("data"), preds_spec,
                         P())
         else:
-            def k_rounds_body(bins_l, y_l, w_l, preds_l):
+            def k_rounds_body(bins_tl, y_l, w_l, preds_l):
                 def step(preds_c, _):
-                    return round_body(bins_l, y_l, w_l, preds_c)
+                    return round_body(bins_tl, y_l, w_l, preds_c)
 
                 return jax.lax.scan(step, preds_l, None, length=n_rounds)
 
-            in_specs = (P("data", None), P("data"), P("data"), preds_spec)
+            in_specs = (P(None, "data"), P("data"), P("data"), preds_spec)
 
         mapped = shard_map(
             k_rounds_body,

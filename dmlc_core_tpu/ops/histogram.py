@@ -40,7 +40,8 @@ from jax.experimental import pallas as pl
 
 from dmlc_core_tpu.base.logging import log_fatal
 
-__all__ = ["build_histogram", "histogram_methods", "reference_histogram"]
+__all__ = ["build_histogram", "fused_descend_histogram",
+           "histogram_methods", "reference_histogram"]
 
 # rows per MXU block: one-hot RHS is [R, F·B] bf16 — at F=28, B=256 and
 # R=8192 that is ~117MB, safely inside HBM working set while keeping the
@@ -55,20 +56,31 @@ def histogram_methods() -> list[str]:
 _TILE_ROWS = 4096  # pallas row-tile; shared by the kernel and its guard
 
 
+def _pack_factor(n_nodes: int, n_bins: int) -> int:
+    """Row-subtiles packed per MXU dot (block-structured LHS so S row
+    ranges share one [S·A, T] dot).  Measured on v5e: ALWAYS 1 — narrow
+    dots do not pad to 128 sublanes (a [A, T]·[T, 128] dot costs ~A/128
+    of a full pass), so packing only inflates the [S·A, T] one-hot
+    construction, which is the actual per-level floor.  Kept as a
+    seam for hardware where narrow matmuls do pay full freight."""
+    return 1
+
+
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1,
                bins_itemsize: int = 1) -> bool:
     """The factored kernel works for any n_bins; the binding constraint is
-    the [Fp, A, lo] f32 accumulator block.  Empirically calibrated on
+    the [Fp, S·A, lo] f32 accumulator block.  Empirically calibrated on
     v5e at tile_rows=4096: nominal accumulators up to 32MB compile and
     run (Mosaic windows the out block; fori_loop temporaries are reused,
     so per-row working-set formulas wildly overestimate), 64MB fails —
     the 24MB budget keeps a safety margin below the measured boundary.
     The [Fp, R] bins input block scales with the bin dtype
     (``bins_itemsize``): uint8 from apply_bins, int32 for >256 bins."""
-    lo = min(n_bins, 128)
+    lo = _lo_factor(n_nodes, n_bins)
     hi = -(-n_bins // lo)
     fp = -(-n_features // 8) * 8
-    acc = fp * 2 * n_nodes * hi * max(lo, 128) * 4
+    sa = _pack_factor(n_nodes, n_bins) * 2 * n_nodes * hi
+    acc = fp * sa * max(lo, 128) * 4
     bins_tile = fp * _TILE_ROWS * bins_itemsize
     return acc <= 24 << 20 and bins_tile <= 8 << 20
 
@@ -81,29 +93,38 @@ def build_histogram(
     n_nodes: int,
     n_bins: int,
     method: str = "auto",
+    *,
+    transposed: bool = False,
 ) -> jax.Array:
     """Return ``hist[2, n_nodes, F, n_bins]`` — plane 0 Σgrad, plane 1 Σhess.
 
     Static ``n_nodes``/``n_bins`` keep shapes XLA-compilable; rows with
     ``node_id < 0`` (e.g. padding) contribute nothing.
+
+    ``transposed=True`` means ``bins`` is already ``[F, n]`` — the Pallas
+    kernel's native layout.  The training loop stores bins transposed so
+    the per-level kernel never re-transposes the matrix (a full HBM
+    round-trip per histogram otherwise).
     """
+    F = bins.shape[0] if transposed else bins.shape[1]
     itemsize = jnp.dtype(bins.dtype).itemsize
     if method == "auto":
         if jax.default_backend() == "tpu":
-            method = ("pallas" if _pallas_ok(n_bins, bins.shape[1], n_nodes,
-                                             itemsize)
+            method = ("pallas" if _pallas_ok(n_bins, F, n_nodes, itemsize)
                       else "matmul")
         else:
             method = "segment"
-    if method == "pallas" and not _pallas_ok(n_bins, bins.shape[1], n_nodes,
-                                             itemsize):
+    if method == "pallas" and not _pallas_ok(n_bins, F, n_nodes, itemsize):
         method = "matmul"  # shapes the kernel can't tile — use the XLA path
     if method == "segment":
-        return _hist_segment(bins, node_id, grad, hess, n_nodes, n_bins)
+        return _hist_segment(bins.T if transposed else bins,
+                             node_id, grad, hess, n_nodes, n_bins)
     if method == "matmul":
-        return _hist_matmul(bins, node_id, grad, hess, n_nodes, n_bins)
+        return _hist_matmul(bins.T if transposed else bins,
+                            node_id, grad, hess, n_nodes, n_bins)
     if method == "pallas":
-        return _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins)
+        return _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
+                            transposed=transposed)
     log_fatal(f"build_histogram: unknown method {method!r}")
 
 
@@ -176,57 +197,86 @@ def _hist_matmul(bins, node_id, grad, hess, n_nodes, n_bins,
 
 
 def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
-                        *, n_nodes, hi, lo):
-    """One row-tile of the FACTORED one-hot matmul.
+                        *, n_nodes, hi, lo, pack):
+    """One row-tile of the FACTORED, SUBTILE-PACKED one-hot matmul.
 
     bin = hi_part·lo + lo_part.  Per feature, ONE MXU dot
-    ``[A, R] · [lo, R]ᵀ`` where the LHS one-hot encodes
-    (grad/hess plane, node, hi_part) scaled by g/h and the RHS encodes
-    lo_part.  With lo=128 and A = 2·N·hi ≤ 128 (true for every level of
-    a depth-≤6 tree at 256 bins) both MXU dimensions are FULL — the
-    naive ``[R, 2N]ᵀ·[R, F·B]`` layout pads 2N→128 sublanes and streams
-    B/128 lane-tiles, wasting ≥2× the MXU cycles.  One-hots live only in
-    VMEM values (never HBM); HBM traffic is the bin matrix itself.
+    ``[S·A, T] · [lo, T]ᵀ`` where A = 2·N·hi one-hot sublanes encode
+    (grad/hess plane, node, hi_part) scaled by g/h, the RHS encodes
+    lo_part, and ``pack`` = S independent row subtiles of T/S rows each
+    share the dot: subtile s's rows one-hot only into sublane block
+    [s·A, (s+1)·A), so cross-subtile terms vanish and the [S, A, lo]
+    output slabs just sum.  This keeps the systolic array FULL at
+    shallow tree levels — without packing a level with A=8 (root, 256
+    bins) pads 8→128 sublanes and wastes 94% of the MXU; with it every
+    level costs ~A/128 of a full pass and a depth-6 tree's histogram
+    work drops from 6 full passes to ~1 (sibling subtraction at the
+    call site halves A again).  One-hots live only in VMEM values
+    (never HBM); HBM traffic is the bin matrix itself.
 
-    Layout: everything arrives TRANSPOSED (rows on lanes — bins [F, R],
-    node/g/h [1, R]) so the per-feature loop can be a fori_loop that
+    Layout: everything arrives TRANSPOSED (rows on lanes — bins [F, T],
+    node/g/h [1, T]) so the per-feature loop can be a fori_loop that
     dynamically slices the ref's major dim; a Python unroll over 28
     features blows the scoped-vmem stack, and Mosaic lowers neither
     dynamic_slice on values nor lane-dim dynamic ref slices.  Vector
     compares run in int32 (bf16/int16 compares rejected by this target).
     """
     i = pl.program_id(0)
-    F, R = bins_ref.shape
+    F, T = bins_ref.shape
+    A = 2 * n_nodes * hi
+    nh = n_nodes * hi
 
-    node = node_ref[:].astype(jnp.int32)                              # [1, R]
-    g = g_ref[:].astype(jnp.bfloat16)                                 # [1, R]
+    node = node_ref[:].astype(jnp.int32)                              # [1, T]
+    g = g_ref[:].astype(jnp.bfloat16)                                 # [1, T]
     h = h_ref[:].astype(jnp.bfloat16)
 
     @pl.when(i == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    a_iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes * hi, R), 0)
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (lo, R), 0)
+    _accum_hist(bins_ref, out_ref, node, g, h,
+                n_nodes=n_nodes, hi=hi, lo=lo, pack=pack)
+
+
+def _accum_hist(bins_ref, out_ref, node, g, h, *, n_nodes, hi, lo, pack):
+    """Shared histogram accumulation loop (see _hist_pallas_kernel doc)."""
+    F, T = bins_ref.shape
+    nh = n_nodes * hi
+    nh_iota = jax.lax.broadcasted_iota(jnp.int32, (pack * nh, T), 0)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (lo, T), 0)
+    # sublane base of each row's subtile block: (r // (T/S)) · nh
+    sub_base = (jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                // (T // pack)) * nh
+    valid = node >= 0
+    t0_node = jnp.where(valid, sub_base + jnp.where(valid, node, 0) * hi,
+                        jnp.int32(-(1 << 20)))                        # [1, T]
 
     def body(fg, carry):
         # feature GROUPS of 8: sublane-dim ref slices must be 8-aligned
         # (pl.multiple_of proves it); within a group a static unroll —
-        # a full 28-feature unroll blows the scoped-vmem stack
+        # a full 28-feature unroll blows the scoped-vmem stack.  The
+        # integer prep runs BATCHED on [8, T] (a [1, T] op costs the
+        # same VPU tiles as [8, T] — sublane padding), only the one-hot
+        # compares are per-feature.
         base = pl.multiple_of(fg * 8, 8)
-        blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)           # [8, R]
+        blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)           # [8, T]
+        # padding rows carry t0_node ≈ -2^20 → t0 < 0 → match nothing
+        t0s = t0_node + blk // lo                                     # [8, T]
+        los = blk % lo                                                # [8, T]
         for k in range(8):
-            bf = blk[k:k + 1]                                         # [1, R]
-            # node<0 (padding) → acol negative → matches no row → 0 col
-            acol = node * hi + bf // lo                               # [1, R]
-            oh = (a_iota == acol).astype(jnp.bfloat16)                # [N·hi, R]
-            lhs = jnp.concatenate([oh * g, oh * h], axis=0)           # [A, R]
-            rhs = (lo_iota == bf % lo).astype(jnp.bfloat16)           # [lo, R]
+            # ONE [nh, T] compare then scale by g and h (the grad/hess
+            # planes share the one-hot) — 2× cheaper than comparing a
+            # [2·nh, T] iota twice.  compare→astype→mul (NOT where):
+            # Mosaic can't relayout an i1 mask against a [1, T]-
+            # replicated where operand.
+            oh = (nh_iota == t0s[k:k + 1]).astype(jnp.bfloat16)       # [Snh, T]
+            lhs = jnp.concatenate([oh * g, oh * h], axis=0)           # [2Snh, T]
+            rhs = (lo_iota == los[k:k + 1]).astype(jnp.bfloat16)      # [lo, T]
             d = jax.lax.dot_general(
                 lhs, rhs,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )                                                          # [A, lo]
+            )                                                          # [2Snh, lo]
             idx = (pl.ds(fg * 8 + k, 1), slice(None), slice(None))
             out_ref[idx] = out_ref[idx] + d[None]
         return carry
@@ -234,30 +284,103 @@ def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
     jax.lax.fori_loop(0, F // 8, body, 0)
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6))
+def _fused_kernel(bins_ref, node_ref, feat_ref, thr_ref, g_ref, h_ref,
+                  out_ref, node_out_ref, *, n_prev, hi, lo, pack):
+    """Descend one tree level AND build the new level's left-child
+    histograms in one pass over the bin tile.
+
+    Each row arrives with its level-(ℓ−1) node id and that node's chosen
+    split (feat_sel, thr_sel, gathered outside).  Phase 1 extracts the
+    selected feature's bin during a cheap batched sweep of the tile
+    (compare-and-sum over sublane groups — the tile is already in VMEM,
+    so the standalone descend's second HBM pass over the bin matrix
+    disappears).  The advanced node id is written out, then phase 2 runs
+    the shared histogram loop over LEFT children only (odd ids one-hot
+    to nothing — sibling subtraction happens at the call site)."""
+    i = pl.program_id(0)
+    F, T = bins_ref.shape
+
+    node = node_ref[:].astype(jnp.int32)                              # [1, T]
+    g = g_ref[:].astype(jnp.bfloat16)
+    h = h_ref[:].astype(jnp.bfloat16)
+    fsel = feat_ref[:].astype(jnp.int32)                              # [1, T]
+    tsel = thr_ref[:].astype(jnp.int32)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    g8_iota = jax.lax.broadcasted_iota(jnp.int32, (8, T), 0)
+
+    def sel_body(fg, sel):
+        base = pl.multiple_of(fg * 8, 8)
+        blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)           # [8, T]
+        pick = (g8_iota + base == fsel).astype(jnp.int32)             # [8, T]
+        return sel + jnp.sum(pick * blk, axis=0, keepdims=True)
+
+    sel_bin = jax.lax.fori_loop(0, F // 8, sel_body,
+                                jnp.zeros((1, T), jnp.int32))
+    valid = node >= 0
+    new_node = jnp.where(valid, 2 * node + (sel_bin > tsel), -1)      # [1, T]
+    node_out_ref[:] = new_node
+
+    # left children only: even ids → parent index, odd → build nothing
+    node_h = jnp.where(valid & (new_node % 2 == 0), new_node >> 1, -1)
+    _accum_hist(bins_ref, out_ref, node_h, g, h,
+                n_nodes=n_prev, hi=hi, lo=lo, pack=pack)
+
+
+def _lo_factor(n_nodes: int, n_bins: int) -> int:
+    """Bin-factor split ``bin = hi·lo + lo_part``.  MXU work A·lo =
+    2·N·n_bins is invariant in ``lo``, but the per-feature construction
+    is ~c₁·A (LHS one-hots) + c₂·lo (RHS one-hot), so small ``lo``
+    trades RHS compare traffic for LHS height.  v5e measurements (4M
+    rows, 28 features) put the knee at lo=32 for shallow levels; deeper
+    levels (A ≥ 64 at lo=128) prefer the classic 128."""
+    best, best_cost = 128, None
+    for lo in (32, 64, 128):
+        if lo > max(n_bins, 8):
+            continue
+        hi = -(-n_bins // lo)
+        A = 2 * n_nodes * hi
+        cost = 5 * A + 2 * lo          # construction op counts per element
+        if best_cost is None or cost < best_cost:
+            best, best_cost = lo, cost
+    return best
+
+
+@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
 def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
-                 tile_rows: int = _TILE_ROWS):
+                 tile_rows: int = _TILE_ROWS, lo: int = 0,
+                 transposed: bool = False):
     """Pallas TPU path: grid over row tiles, all tiles accumulate into the
-    same [F, A, lo] VMEM output block (sequential TPU grid ⇒ safe), then
-    one small reshape/transpose back to [2, N, F, B]."""
-    n, F = bins.shape
-    lo = min(n_bins, 128)
+    same [F, S·A, lo] VMEM output block (sequential TPU grid ⇒ safe),
+    then the S packed subtile slabs sum and one small reshape/transpose
+    yields [2, N, F, B]."""
+    if transposed:
+        F, n = bins.shape
+    else:
+        n, F = bins.shape
+    lo = min(lo or _lo_factor(n_nodes, n_bins), n_bins)
     hi = -(-n_bins // lo)
     A = 2 * n_nodes * hi
+    S = _pack_factor(n_nodes, n_bins)
     Fp = -(-F // 8) * 8          # feature groups of 8 (sublane alignment)
     pad = (-n) % tile_rows
     if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
         node_id = jnp.pad(node_id, (0, pad), constant_values=-1)
         grad = jnp.pad(grad, (0, pad))
         hess = jnp.pad(hess, (0, pad))
     n_pad = n + pad
     grid = n_pad // tile_rows
-    bins_t = jnp.pad(bins.T, ((0, Fp - F), (0, 0)))
+    if transposed:
+        bins_t = jnp.pad(bins, ((0, Fp - F), (0, pad)))
+    else:
+        bins_t = jnp.pad(bins.T, ((0, Fp - F), (0, pad)))
 
     out = pl.pallas_call(
-        partial(_hist_pallas_kernel, n_nodes=n_nodes, hi=hi, lo=lo),
-        out_shape=jax.ShapeDtypeStruct((Fp, A, lo), jnp.float32),
+        partial(_hist_pallas_kernel, n_nodes=n_nodes, hi=hi, lo=lo, pack=S),
+        out_shape=jax.ShapeDtypeStruct((Fp, S * A, lo), jnp.float32),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((Fp, tile_rows), lambda i: (0, i)),
@@ -265,13 +388,112 @@ def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
             pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
             pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((Fp, A, lo), lambda i: (0, 0, 0)),
+        out_specs=pl.BlockSpec((Fp, S * A, lo), lambda i: (0, 0, 0)),
         interpret=jax.default_backend() != "tpu",
     )(bins_t, node_id.reshape(1, n_pad), grad.reshape(1, n_pad),
       hess.reshape(1, n_pad))
-    # [Fp, (gh, N, hi), lo] → [gh, N, F, hi·lo] → slice feature/bin pads
-    out = out[:F].reshape(F, 2, n_nodes, hi * lo).transpose(1, 2, 0, 3)
+    # [Fp, (gh, S, N, hi), lo] → Σ over S → [gh, N, F, hi·lo] → slice pads
+    out = out[:F].reshape(F, 2, S, n_nodes, hi * lo).sum(axis=2)
+    out = out.transpose(1, 2, 0, 3)
     return out[..., :n_bins]
+
+
+@partial(jax.jit, static_argnums=(6, 7, 8, 9))
+def _fused_pallas(bins_t, node_id, feat_sel, thr_sel, grad, hess,
+                  n_prev, n_bins, tile_rows: int = _TILE_ROWS, lo: int = 0):
+    """Fused descend+histogram wrapper (bins already [F, n]).  Returns
+    ``(left_hist [2, n_prev, F, B], new_node [n])`` where new_node is
+    the level-ℓ assignment and left_hist[_, p] is the histogram of
+    parent p's LEFT child."""
+    F, n = bins_t.shape
+    lo = min(lo or _lo_factor(n_prev, n_bins), n_bins)
+    hi = -(-n_bins // lo)
+    A = 2 * n_prev * hi
+    S = _pack_factor(n_prev, n_bins)
+    Fp = -(-F // 8) * 8
+    pad = (-n) % tile_rows
+    if pad:
+        node_id = jnp.pad(node_id, (0, pad), constant_values=-1)
+        feat_sel = jnp.pad(feat_sel, (0, pad))
+        thr_sel = jnp.pad(thr_sel, (0, pad))
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+    n_pad = n + pad
+    grid = n_pad // tile_rows
+    bins_p = jnp.pad(bins_t, ((0, Fp - F), (0, pad)))
+
+    hist, new_node = pl.pallas_call(
+        partial(_fused_kernel, n_prev=n_prev, hi=hi, lo=lo, pack=S),
+        out_shape=(
+            jax.ShapeDtypeStruct((Fp, S * A, lo), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        ),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((Fp, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+        ],
+        out_specs=(
+            pl.BlockSpec((Fp, S * A, lo), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(bins_p, node_id.reshape(1, n_pad), feat_sel.reshape(1, n_pad),
+      thr_sel.reshape(1, n_pad), grad.reshape(1, n_pad),
+      hess.reshape(1, n_pad))
+    out = hist[:F].reshape(F, 2, S, n_prev, hi * lo).sum(axis=2)
+    out = out.transpose(1, 2, 0, 3)[..., :n_bins]
+    return out, new_node.reshape(n_pad)[:n]
+
+
+def fused_descend_histogram(
+    bins_t: jax.Array,      # [F, n] — transposed binned matrix
+    node_id: jax.Array,     # [n] — node ids at level ℓ−1 (−1 = padding)
+    feat_sel: jax.Array,    # [n] — each row's node's chosen split feature
+    thr_sel: jax.Array,     # [n] — chosen split threshold (bin index)
+    grad: jax.Array,
+    hess: jax.Array,
+    n_prev: int,            # number of level-(ℓ−1) nodes
+    n_bins: int,
+    method: str = "auto",
+    fuse: bool = False,
+):
+    """Advance rows one level down the tree and build the new level's
+    LEFT-child histograms.  Returns ``(left_hist, new_node)`` with
+    ``left_hist[_, p]`` the histogram of parent p's left child (node
+    2p) — the caller derives the right child by sibling subtraction.
+    Replaces rabit's per-level hist allreduce prep (SURVEY.md §2e
+    data-parallel row).
+
+    ``fuse=True`` runs descend + histogram as ONE Pallas kernel (single
+    HBM read of the bin tile).  Measured on v5e it is mildly NEGATIVE
+    (−5%: the serial in-kernel select loop beats XLA's overlapped
+    standalone descend pass), so the default is the two-pass form; the
+    fused kernel is kept for parts where HBM bandwidth, not VPU issue
+    rate, binds."""
+    F = bins_t.shape[0]
+    itemsize = jnp.dtype(bins_t.dtype).itemsize
+    use_pallas = (fuse and method in ("auto", "pallas")
+                  and jax.default_backend() == "tpu"
+                  and _pallas_ok(n_bins, F, n_prev, itemsize))
+    if use_pallas:
+        return _fused_pallas(bins_t, node_id, feat_sel, thr_sel,
+                             grad, hess, n_prev, n_bins)
+    # unfused fallback: XLA descend, then the regular histogram
+    valid = node_id >= 0
+    row_bin = jnp.sum(
+        jnp.where(feat_sel[None, :]
+                  == jnp.arange(F, dtype=jnp.int32)[:, None],
+                  bins_t.astype(jnp.int32), 0), axis=0)
+    new_node = jnp.where(valid, 2 * node_id + (row_bin > thr_sel), -1)
+    node_h = jnp.where(valid & (new_node % 2 == 0), new_node >> 1, -1)
+    hist = build_histogram(bins_t, node_h, grad, hess, n_prev, n_bins,
+                           method, transposed=True)
+    return hist, new_node
 
 
 def reference_histogram(bins, node_id, grad, hess, n_nodes, n_bins):

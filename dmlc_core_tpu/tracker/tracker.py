@@ -119,8 +119,13 @@ class RabitTracker:
         if rank < 0 or not state["persistent"]:
             return  # one-shot legacy connection: close is not a death signal
         with self._lock:
-            if self._alive.pop(rank, None) is None:
+            # only the CURRENT owner's close is a death: a worker that
+            # reconnected ('recover') replaced _alive[rank] with its new
+            # socket, and the stale connection's eventual close must not
+            # evict the live worker or hand its rank to a replacement
+            if self._alive.get(rank) is not state.get("conn"):
                 return
+            del self._alive[rank]
             if not state["clean"]:
                 self.dead_workers.append(rank)
                 self._free_ranks.append(rank)
@@ -168,6 +173,7 @@ class RabitTracker:
                     self._host_rank[msg["host"]] = rank
                 if rank < self.nworker and msg.get("persistent") and conn is not None:
                     state["rank"], state["persistent"] = rank, True
+                    state["conn"] = conn
                     self._alive[rank] = conn
             if rank >= self.nworker:
                 return {"error": f"too many workers (nworker={self.nworker})"}

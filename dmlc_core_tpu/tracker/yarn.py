@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import subprocess
+import threading
 import time
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -230,10 +232,14 @@ def _hadoop_submit_fn(command: List[str], submit_timeout: float = 120.0,
     """Production submit_fn: one 1-container app per worker via hadoop CLI.
 
     The distributed-shell client *monitors* its app until completion, so we
-    must NOT wait for the process — we stream its combined stdout+stderr
-    (hadoop logs via log4j to stderr by default) just long enough to see
-    the ``Submitted application application_...`` line, then leave the
-    client running in the background as a harmless monitor.
+    must NOT wait for the process — a reader thread watches its combined
+    stdout+stderr (hadoop logs via log4j to stderr by default) just long
+    enough to see the ``Submitted application application_...`` line, then
+    keeps DRAINING the pipe in the background (a client that outlives the
+    parse would otherwise fill the OS pipe buffer and deadlock) and reaps
+    the process when it exits.  The deadline applies to the submission as
+    a whole, so a silent client (unreachable ResourceManager, Kerberos
+    stall) raises instead of blocking forever in readline.
     """
     def submit(task_id: int, env: Dict[str, str]) -> str:
         cmd = build_command(1, command, env,
@@ -242,22 +248,40 @@ def _hadoop_submit_fn(command: List[str], submit_timeout: float = 120.0,
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True,
                                 env=dict(os.environ))
-        deadline = time.monotonic() + submit_timeout
-        seen: List[str] = []
         assert proc.stdout is not None
-        for line in proc.stdout:
-            seen.append(line)
-            for tok in line.split():
-                if tok.startswith("application_"):
-                    return tok.strip(",;")
-            if time.monotonic() > deadline:
-                proc.kill()
-                raise Error(f"yarn submission for task {task_id} produced no "
-                            f"application id within {submit_timeout}s")
-        rc = proc.wait()
-        raise Error(f"yarn submission for task {task_id} exited rc={rc} "
+        found: queue.Queue = queue.Queue()
+        seen: List[str] = []
+
+        def reader() -> None:
+            app_reported = False
+            for line in proc.stdout:
+                if not app_reported:
+                    seen.append(line)
+                    for tok in line.split():
+                        if tok.startswith("application_"):
+                            found.put(tok.strip(",;"))
+                            app_reported = True
+                            break
+                # else: keep draining so the monitor never blocks on a
+                # full pipe
+            rc = proc.wait()  # reap; no zombie per submission
+            if not app_reported:
+                found.put(Error(
+                    f"yarn submission for task {task_id} exited rc={rc} "
                     f"without an application id; output tail: "
-                    f"{''.join(seen[-20:])!r}")
+                    f"{''.join(seen[-20:])!r}"))
+
+        threading.Thread(target=reader, daemon=True,
+                         name=f"yarn-submit-{task_id}").start()
+        try:
+            result = found.get(timeout=submit_timeout)
+        except queue.Empty:
+            proc.kill()
+            raise Error(f"yarn submission for task {task_id} produced no "
+                        f"application id within {submit_timeout}s")
+        if isinstance(result, Error):
+            raise result
+        return result
     return submit
 
 
