@@ -12,10 +12,16 @@ Prints ONE JSON line:
    "unit": "rounds/s/chip", "vs_baseline": N, ...}
 
 vs_baseline: the reference publishes no numbers (SURVEY.md §6); the target
-is the BASELINE.json north star — XGBoost 2.x hist on one 8×A100 NCCL node
-trains HIGGS-10M at roughly 8 rounds/s aggregate (~1 round/s/GPU at depth
-6, 256 bins; public xgboost-bench figures), so parity per chip ≈ 1.0
-round/s/chip.  vs_baseline = value / 1.0.
+is the BASELINE.json north star — XGBoost+NCCL on one 8×A100 node at
+HIGGS-10M.  Comparator derivation (BASELINE.md "comparator" section for
+the full provenance and uncertainty band): public single-GPU
+``gpu_hist``/``hist`` HIGGS benchmarks cluster around 10-17 rounds/s at
+this config, and public multi-GPU scaling on a 10M-row dataset is poor
+(allreduce-bound; dask-xgboost benchmarks show ≤2× aggregate on 8 GPUs),
+giving an aggregate ≈ 16-34 rounds/s → **2.0 rounds/s per chip** as the
+mid-band per-GPU effective rate.  vs_baseline = value / 2.0.  This
+environment has no network and no xgboost wheel, so the comparator is
+pinned from cited public figures, not re-measured here.
 """
 
 import json
@@ -28,8 +34,51 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np  # noqa: E402
 
 
+#: bf16 peak of the chips this bench is expected to land on, for the MFU
+#: line.  v5e: 197 TFLOP/s bf16 (public spec).  Unknown platforms → 0 →
+#: mfu reported as null rather than against a made-up peak.
+_PEAK_BF16 = {"tpu": 197e12}
+
+
+def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
+                     n_chips=1):
+    """Auditable per-round cost model of the sibling-subtracted round.
+
+    MXU flops: per level ℓ the Pallas histogram dot is [A, T]·[T, lo]
+    over all rows with A = 2·n_build·ceil(B/lo); sibling subtraction
+    makes n_build = 1, 1, 2, 4, ... and ops._lo_factor picks lo.  HBM
+    bytes: the bin matrix (uint8) is read once by each level's histogram
+    pass and once by each level's descend pass, plus the f32 row vectors
+    (g, h, preds, margin update).  psum bytes: the per-level left-child
+    histogram [2, n_build, F, B] f32 — what each chip contributes to the
+    in-step histogram-sync allreduce (the rabit-allreduce replacement)."""
+    from dmlc_core_tpu.ops.histogram import _lo_factor
+
+    rows = rows // n_chips    # per-chip row share: metrics are per chip,
+    mxu_flops = 0             # matching rounds_per_sec_per_chip
+    psum_bytes = 0
+    for level in range(depth):
+        n_build = 1 if level == 0 else 1 << (level - 1)
+        lo = _lo_factor(n_build, n_bins)
+        hi = -(-n_bins // lo)
+        mxu_flops += 2 * (2 * n_build * hi) * lo * rows * feats
+        psum_bytes += 2 * n_build * feats * n_bins * 4
+    hbm = depth * rows * feats * 2        # hist read + descend read, uint8
+    hbm += 6 * rows * 4                   # g/h/preds/update f32 vectors
+    peak = _PEAK_BF16.get(platform, 0)
+    mfu = (mxu_flops / seconds_per_round / peak) if peak else None
+    return {
+        "mxu_flops_per_round": mxu_flops,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "hbm_bytes_per_round": hbm,
+        "hbm_gbps": round(hbm / seconds_per_round / 1e9, 1),
+        "hist_psum_bytes_per_round": psum_bytes,
+    }
+
+
 def main() -> None:
-    rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    # default = the north-star config (BASELINE.md config 1): HIGGS-10M
+    rows = int(os.environ.get("BENCH_ROWS", 10_000_000))
     feats = int(os.environ.get("BENCH_FEATURES", 28))
     rounds = int(os.environ.get("BENCH_ROUNDS", 100))
     warmup = int(os.environ.get("BENCH_WARMUP", 10))
@@ -102,8 +151,10 @@ def main() -> None:
     seconds = model.last_fit_seconds
     rounds_per_sec_per_chip = rounds / seconds / n_chips
 
-    target = 1.0  # rounds/s/chip ≈ per-GPU rate of the 8×A100 NCCL baseline
-    print(json.dumps({
+    # per-GPU effective rate of the 8×A100 NCCL baseline (mid-band; see
+    # module docstring + BASELINE.md comparator section for provenance)
+    target = 2.0
+    out = {
         "metric": "histgbt_rounds_per_sec_per_chip",
         "value": round(rounds_per_sec_per_chip, 4),
         "unit": "rounds/s/chip",
@@ -116,7 +167,10 @@ def main() -> None:
         "chips": n_chips,
         "platform": platform,
         "seconds": round(seconds, 3),
-    }))
+    }
+    out.update(_derived_metrics(rows, feats, depth, n_bins,
+                                seconds / rounds, platform, n_chips))
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

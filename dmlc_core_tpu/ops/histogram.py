@@ -53,7 +53,7 @@ def histogram_methods() -> list[str]:
     return ["auto", "segment", "matmul", "pallas"]
 
 
-_TILE_ROWS = 4096  # pallas row-tile; shared by the kernel and its guard
+_TILE_ROWS = 8192  # pallas row-tile; v5e sweep: ~3-8% over 4096 at all levels
 
 
 def _pack_factor(n_nodes: int, n_bins: int) -> int:
@@ -222,10 +222,6 @@ def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
     compares run in int32 (bf16/int16 compares rejected by this target).
     """
     i = pl.program_id(0)
-    F, T = bins_ref.shape
-    A = 2 * n_nodes * hi
-    nh = n_nodes * hi
-
     node = node_ref[:].astype(jnp.int32)                              # [1, T]
     g = g_ref[:].astype(jnp.bfloat16)                                 # [1, T]
     h = h_ref[:].astype(jnp.bfloat16)

@@ -50,6 +50,32 @@ class TestHistogram:
         ref = reference_histogram(bins, node, g, h, N, B)
         np.testing.assert_allclose(out, ref, atol=2e-2, rtol=1e-2)  # bf16 dot
 
+    def test_fused_descend_matches_two_pass(self, rng):
+        # the fused Pallas descend+histogram (off by default on v5e, env
+        # knob DMLC_TPU_FUSED_DESCEND) must stay in lockstep with the
+        # two-pass form: exact node routing, bf16-tolerance histograms.
+        # Interpret mode off-TPU exercises the kernel logic in CI.
+        from dmlc_core_tpu.ops.histogram import (_fused_pallas,
+                                                 fused_descend_histogram)
+
+        n, F, B, N = 9000, 6, 128, 4   # crosses the 8192 row tile
+        bins_t = jnp.asarray(rng.integers(0, B, size=(F, n)).astype(np.uint8))
+        node = rng.integers(0, N, size=n).astype(np.int32)
+        node[::7] = -1                 # padding rows stay -1 and drop out
+        node_d = jnp.asarray(node)
+        fs = jnp.asarray(rng.integers(0, F, size=n).astype(np.int32))
+        ts = jnp.asarray(rng.integers(0, B - 1, size=n).astype(np.int32))
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        h = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+        hist_f, node_f = _fused_pallas(bins_t, node_d, fs, ts, g, h, N, B)
+        hist_u, node_u = fused_descend_histogram(
+            bins_t, node_d, fs, ts, g, h, N, B, "segment", fuse=False)
+        np.testing.assert_array_equal(np.asarray(node_f), np.asarray(node_u))
+        np.testing.assert_allclose(np.asarray(hist_f), np.asarray(hist_u),
+                                   atol=3e-2, rtol=1e-2)
+        # padding rows must remain -1 after the descend
+        assert np.all(np.asarray(node_f)[::7] == -1)
+
     def test_pallas_guard(self):
         from dmlc_core_tpu.ops.histogram import _pallas_ok
 
