@@ -46,7 +46,8 @@ from dmlc_core_tpu.base.parameter import Parameter, field
 from dmlc_core_tpu.base.registry import Registry
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.ops.histogram import (build_histogram,
-                                         fused_descend_histogram)
+                                         fused_descend_histogram,
+                                         select_feature_bins)
 from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts
 from dmlc_core_tpu.parallel.mesh import local_mesh
 
@@ -245,12 +246,14 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
 # -- external-memory page kernels (jitted once per page shape) --------------
 
 @jax.jit
-def _advance_node(bins, node, feat, thr):
-    """Route rows one level down the tree; padding rows (node<0) stay -1."""
+def _advance_node(bins_t, node, feat, thr):
+    """Route rows one level down the tree; padding rows (node<0) stay -1.
+    ``bins_t`` is feature-major [F, n]; the selected feature's bin comes
+    from ops.select_feature_bins (shared gather-free select)."""
     valid = node >= 0
     safe = jnp.where(valid, node, 0)
-    row_bin = jnp.take_along_axis(bins, feat[safe][:, None], axis=1)[:, 0]
-    nxt = 2 * safe + (row_bin.astype(jnp.int32) > thr[safe]).astype(jnp.int32)
+    row_bin = select_feature_bins(bins_t, feat[safe])
+    nxt = 2 * safe + (row_bin > thr[safe]).astype(jnp.int32)
     return jnp.where(valid, nxt, -1)
 
 
@@ -480,11 +483,73 @@ class HistGBT:
             init_margin,
             mat_sharding if K_cls > 1 else row_sharding)
 
-        # chunk rounds: K boosting rounds per dispatch (lax.scan inside the
-        # jitted program).  Per-dispatch + per-fetch latency (hundreds of
-        # ms through a remote-device tunnel) would otherwise dominate the
-        # actual ~100ms of round compute; trees stay on device until the
-        # end of fit
+        # validation state (binned once; margins updated incrementally)
+        eval_bins = eval_margin = yv_d = None
+        if eval_set is not None:
+            Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
+            yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
+            eval_bins = apply_bins(jnp.asarray(Xv), self.cuts)
+            eval_margin = jnp.full(self._margin_shape(len(yv)),
+                                   p.base_score, jnp.float32)
+            if continuing:
+                eval_margin = self._apply_trees(
+                    eval_bins, self._stacked_trees(self.trees), eval_margin)
+            yv_d = jnp.asarray(yv)
+        self.best_iteration = None
+        self.best_score = None
+        self._early_stopped = bool(early_stopping_rounds)
+        if p.eval_metric:
+            metric_fn, maximize = EVAL_METRICS[p.eval_metric]
+            metric_name = p.eval_metric
+        else:
+            metric_fn, maximize = self._obj.metric, False
+            metric_name = "loss"
+        state = {"best_at": 0, "eval_margin": eval_margin}
+
+        def after_chunk(done, preds_c, trees_k):
+            if eval_bins is None:
+                return False
+            state["eval_margin"] = self._apply_trees(
+                eval_bins, trees_k, state["eval_margin"])
+            vloss = float(metric_fn(state["eval_margin"], yv_d))
+            improved = (self.best_score is None
+                        or (vloss > self.best_score if maximize
+                            else vloss < self.best_score))
+            if improved:
+                self.best_score = vloss
+                self.best_iteration = n_prior + done - 1
+                state["best_at"] = done
+            elif (early_stopping_rounds
+                  and done - state["best_at"] >= early_stopping_rounds):
+                LOG("INFO", "early stop at round %d (best %s=%.5f @ %d)",
+                    done, metric_name, self.best_score, state["best_at"])
+                return True
+            return False
+
+        preds = self._boost_binned(bins_t, y_d, w_d, preds, F,
+                                   eval_every=eval_every,
+                                   warmup_rounds=warmup_rounds,
+                                   after_chunk=after_chunk)
+        self._train_preds = preds
+        self._n_real_rows = n
+        return self
+
+    def _boost_binned(self, bins_t, y_d, w_d, preds, n_features,
+                      eval_every=0, warmup_rounds=0, after_chunk=None):
+        """Run ``n_trees`` boosting rounds over device-resident binned
+        data (bins feature-major [F, n], rows sharded on the mesh's data
+        axis).  Shared by :meth:`fit` and the cached external-memory
+        path.  Appends trees to ``self.trees``, sets
+        ``last_fit_seconds``, returns the final margins.
+
+        Rounds run in chunks of K per dispatch (lax.scan inside the
+        jitted program): per-dispatch + per-fetch latency (hundreds of
+        ms through a remote-device tunnel) would otherwise dominate the
+        actual per-round compute; trees stay on device until the end.
+        ``after_chunk(done, preds, trees_k) -> stop?`` hooks validation/
+        early-stopping between dispatches.
+        """
+        p = self.param
         K = min(p.n_trees, 25)
         if eval_every:
             # chunk boundaries must land on eval rounds: use the largest
@@ -503,9 +568,9 @@ class HistGBT:
                           jax.random.fold_in(base_key, done))
             return fn(bins_t, y_d, w_d, preds_c)
 
-        kfn = self._build_round_fn(F, K)
+        kfn = self._build_round_fn(n_features, K)
         rem = p.n_trees % K
-        rem_fn = self._build_round_fn(F, rem) if rem else None
+        rem_fn = self._build_round_fn(n_features, rem) if rem else None
         if warmup_rounds > 0:
             # compile + cache-warm on a copy so the real buffer stays
             # valid and model state is untouched (preds is donated).
@@ -518,29 +583,6 @@ class HistGBT:
                 np.asarray(warm[0][:1])
         np.asarray(preds[:1])
 
-        # validation state (binned once; margins updated incrementally)
-        eval_bins = eval_margin = yv_d = None
-        if eval_set is not None:
-            Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
-            yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
-            eval_bins = apply_bins(jnp.asarray(Xv), self.cuts)
-            eval_margin = jnp.full(self._margin_shape(len(yv)),
-                                   p.base_score, jnp.float32)
-            if continuing:
-                eval_margin = self._apply_trees(
-                    eval_bins, self._stacked_trees(self.trees), eval_margin)
-            yv_d = jnp.asarray(yv)
-        self.best_iteration = None
-        self.best_score = None
-        self._early_stopped = bool(early_stopping_rounds)
-        best_at = 0
-        if p.eval_metric:
-            metric_fn, maximize = EVAL_METRICS[p.eval_metric]
-            metric_name = p.eval_metric
-        else:
-            metric_fn, maximize = self._obj.metric, False
-            metric_name = "loss"
-
         t0 = get_time()
         chunks: List[Any] = []
         done = 0
@@ -552,22 +594,8 @@ class HistGBT:
             if eval_every and done % eval_every == 0:
                 loss = float(self._obj.metric(preds, y_d))
                 LOG("INFO", "round %d: loss=%.5f", done, loss)
-            if eval_bins is not None:
-                eval_margin = self._apply_trees(eval_bins, trees_k,
-                                                eval_margin)
-                vloss = float(metric_fn(eval_margin, yv_d))
-                improved = (self.best_score is None
-                            or (vloss > self.best_score if maximize
-                                else vloss < self.best_score))
-                if improved:
-                    self.best_score = vloss
-                    self.best_iteration = n_prior + done - 1
-                    best_at = done
-                elif (early_stopping_rounds
-                      and done - best_at >= early_stopping_rounds):
-                    LOG("INFO", "early stop at round %d (best %s=%.5f @ %d)",
-                        done, metric_name, self.best_score, best_at)
-                    break
+            if after_chunk is not None and after_chunk(done, preds, trees_k):
+                break
         for trees_k in chunks:            # ONE host fetch per chunk
             t_np = jax.tree.map(np.asarray, trees_k)
             k = t_np["leaf"].shape[0]
@@ -575,9 +603,7 @@ class HistGBT:
                 {key: t_np[key][i] for key in t_np} for i in range(k))
         np.asarray(preds[:1])             # real sync before stopping timer
         self.last_fit_seconds = get_time() - t0
-        self._train_preds = preds
-        self._n_real_rows = n
-        return self
+        return preds
 
     def _maybe_allgather(self):
         from dmlc_core_tpu.parallel import collectives as coll
@@ -597,6 +623,7 @@ class HistGBT:
         sketch_pages: int = 32,
         cuts: Optional[jax.Array] = None,
         cache_device: bool = False,
+        warmup_rounds: int = 0,
     ) -> "HistGBT":
         """Out-of-core boosting over a :class:`RowBlockIter` (sparse CSR
         pages from a Parser/DiskRowIter — the Criteo-scale path).
@@ -617,7 +644,13 @@ class HistGBT:
         device instead of re-uploading each page ``depth`` times per tree:
         much faster when the binned data fits HBM (it is 4× smaller than
         the raw f32 matrix), while the default keeps device memory bounded
-        by one page — the true out-of-core mode.
+        by one page — the true out-of-core mode.  Single-worker
+        cache_device runs the in-core chunked engine: identical splits;
+        leaf values carry the histogram-cumsum precision note, and with
+        ``subsample``/``colsample_bytree`` < 1 the *random draws* come
+        from the device PRNG instead of the page loop's numpy PRNG, so
+        the same seed selects a different (equally distributed) sample
+        across the two modes.
         """
         from dmlc_core_tpu.ops.quantile import SketchAccumulator
         from dmlc_core_tpu.parallel import collectives as coll
@@ -654,12 +687,12 @@ class HistGBT:
             CHECK(sketch is not None, "fit_external: empty input")
             self.cuts = sketch.finalize(B, allgather_fn=self._maybe_allgather())
 
-        # -- pass 2: bin pages (uint8) -------------------------------------
+        # -- pass 2: bin pages (uint8, FEATURE-major like fit()) -----------
         K_cls = p.num_class
         pages: List[Dict[str, Any]] = []   # "bins" is a jax.Array when cache_device
         for block in row_iter:
             X = block.to_dense(F)
-            bins = apply_bins(jnp.asarray(X), self.cuts)
+            bins = apply_bins(jnp.asarray(X), self.cuts).T   # [F, page_rows]
             if not cache_device:
                 bins = np.asarray(bins)    # spill to host; one page on
                                            # device at a time (out-of-core)
@@ -679,6 +712,9 @@ class HistGBT:
                           f"multi:softmax labels must be in [0, {K_cls})")
 
         distributed = coll.world_size() > 1
+        if cache_device and not distributed:
+            return self._fit_external_cached(pages, F, eval_every,
+                                             warmup_rounds)
         obj = self._obj
 
         def grow_one_tree(col, feat_mask):
@@ -697,7 +733,12 @@ class HistGBT:
             prev_hist = None
             for level in range(depth):
                 # sibling subtraction (same as grow_tree): below the root
-                # build only left children, derive right = parent − left
+                # build only left children, derive right = parent − left.
+                # Histograms accumulate ON DEVICE across pages and sync as
+                # ONE device allreduce per level (coll.allreduce_device:
+                # XLA AllReduce over ICI/DCN) — the bounded-host-memory
+                # guarantee is unchanged because only O(N·F·B) histogram
+                # state lives on device between pages, never row data.
                 n_nodes = 1 << level
                 n_build = 1 if level == 0 else n_nodes >> 1
                 hist = None
@@ -710,24 +751,23 @@ class HistGBT:
                     ph = build_histogram(
                         jnp.asarray(pg["bins"]), nd,
                         jnp.asarray(g_c), jnp.asarray(h_c),
-                        n_build, B, p.hist_method)
+                        n_build, B, p.hist_method, transposed=True)
                     hist = ph if hist is None else hist + ph
-                hist_np = np.asarray(hist)
                 if distributed:
-                    hist_np = coll.allreduce(hist_np)  # cross-worker sync
+                    hist = coll.allreduce_device(hist)  # cross-worker sync
                 if level > 0:
-                    hist_np = np.stack(
-                        [hist_np, prev_hist - hist_np], axis=2).reshape(
-                        2, n_nodes, hist_np.shape[2], B)
-                prev_hist = hist_np
-                feat, thr, gn = best_split(jnp.asarray(hist_np), feat_mask)
+                    hist = jnp.stack(
+                        [hist, prev_hist - hist], axis=2).reshape(
+                        2, n_nodes, hist.shape[2], B)
+                prev_hist = hist
+                feat, thr, gn = best_split(hist, feat_mask)
                 feats.append(np.pad(np.asarray(feat), (0, half - n_nodes)))
                 thrs.append(np.pad(np.asarray(thr), (0, half - n_nodes)))
                 gains.append(np.pad(np.asarray(gn), (0, half - n_nodes)))
                 for pg in pages:
                     pg["node"] = np.asarray(_advance_node(
                         jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
-                        jnp.asarray(feat), jnp.asarray(thr)))
+                        feat, thr))
             gsum = np.zeros(n_leaf, np.float32)
             hsum = np.zeros(n_leaf, np.float32)
             for pg in pages:
@@ -800,6 +840,51 @@ class HistGBT:
                 loss = obj.finalize_mean_loss(num / max(den, 1))
                 LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
         self.last_fit_seconds = get_time() - t0
+        return self
+
+    def _fit_external_cached(self, pages, F: int, eval_every: int,
+                             warmup_rounds: int = 0) -> "HistGBT":
+        """Device-cached external-memory training = the in-core engine.
+
+        With the binned pages resident in HBM there is nothing
+        out-of-core left per round, so the pages concatenate into one
+        feature-major bin matrix and boosting runs through the same
+        chunked-scan machinery as :meth:`fit` — ONE dispatch per ~25
+        rounds instead of O(pages·depth) host-driven dispatches per
+        round (which a remote-device tunnel turns into seconds of
+        latency per round).
+
+        Memory note: the page concatenation transiently needs ~2× the
+        binned matrix in HBM (sources + destination) before the page
+        refs drop; steady-state residency equals the page loop's.  If
+        that transient doesn't fit, use ``cache_device=False``.
+        """
+        p = self.param
+        ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        y = np.concatenate([pg["y"] for pg in pages])
+        w = np.concatenate([pg["w"] for pg in pages])
+        n = len(y)
+        n_pad = (-n) % ndev
+        bins_t = jnp.concatenate(
+            [jnp.asarray(pg["bins"]) for pg in pages], axis=1)
+        pages.clear()                     # free the per-page device refs
+        if n_pad:
+            bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad)))
+            y = np.concatenate([y, np.zeros(n_pad, np.float32)])
+            w = np.concatenate([w, np.zeros(n_pad, np.float32)])
+        row_sharding = NamedSharding(self.mesh, P("data"))
+        bins_t = jax.device_put(
+            bins_t, NamedSharding(self.mesh, P(None, "data")))
+        y_d = jax.device_put(y, row_sharding)
+        w_d = jax.device_put(w, row_sharding)
+        preds = jax.device_put(
+            np.full(self._margin_shape(n + n_pad), p.base_score, np.float32),
+            NamedSharding(self.mesh, P("data", None))
+            if p.num_class > 1 else row_sharding)
+
+        self._boost_binned(bins_t, y_d, w_d, preds, F,
+                           eval_every=eval_every,
+                           warmup_rounds=warmup_rounds)
         return self
 
     # ------------------------------------------------------------------
@@ -945,15 +1030,10 @@ class HistGBT:
                         jnp.stack([lo_r, up_r], 1)], axis=1
                     ).reshape(2 * n_nodes, 2)
             # final descend (the loop's fused kernels advanced node only
-            # up to level depth-1): select each row's split feature value
-            # gather-free by compare-and-sum over the F rows of bins_tl
+            # up to level depth-1); shared gather-free feature select
             feat_sel = table_select(feat, node, 1 << (depth - 1))
             thr_sel = table_select(thr, node, 1 << (depth - 1))
-            f_iota = jnp.arange(bins_tl.shape[0],
-                                dtype=jnp.int32)[:, None]             # [F, 1]
-            row_bin = jnp.sum(
-                jnp.where(feat_sel[None, :] == f_iota,
-                          bins_tl.astype(jnp.int32), 0), axis=0)      # [n]
+            row_bin = select_feature_bins(bins_tl, feat_sel)          # [n]
             node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
             leaf_w = -gsum / (hsum + lam)
             if mono_arr is not None:

@@ -41,7 +41,8 @@ from jax.experimental import pallas as pl
 from dmlc_core_tpu.base.logging import log_fatal
 
 __all__ = ["build_histogram", "fused_descend_histogram",
-           "histogram_methods", "reference_histogram"]
+           "select_feature_bins", "histogram_methods",
+           "reference_histogram"]
 
 # rows per MXU block: one-hot RHS is [R, F·B] bf16 — at F=28, B=256 and
 # R=8192 that is ~117MB, safely inside HBM working set while keeping the
@@ -481,15 +482,26 @@ def fused_descend_histogram(
                              grad, hess, n_prev, n_bins)
     # unfused fallback: XLA descend, then the regular histogram
     valid = node_id >= 0
-    row_bin = jnp.sum(
-        jnp.where(feat_sel[None, :]
-                  == jnp.arange(F, dtype=jnp.int32)[:, None],
-                  bins_t.astype(jnp.int32), 0), axis=0)
+    row_bin = select_feature_bins(bins_t, feat_sel)
     new_node = jnp.where(valid, 2 * node_id + (row_bin > thr_sel), -1)
     node_h = jnp.where(valid & (new_node % 2 == 0), new_node >> 1, -1)
     hist = build_histogram(bins_t, node_h, grad, hess, n_prev, n_bins,
                            method, transposed=True)
     return hist, new_node
+
+
+def select_feature_bins(bins_t: jax.Array, feat_sel: jax.Array) -> jax.Array:
+    """``bins_t[feat_sel[r], r]`` for every row r, gather-free.
+
+    ``bins_t`` is feature-major [F, n]; a per-row gather over the row
+    dimension serializes badly on TPU, so the selected feature's bin is
+    extracted by compare-and-sum over the F rows (one [F, n] VPU pass).
+    Shared by the tree descend in HistGBT (in-core and external-memory)
+    and the unfused fused_descend_histogram fallback.
+    """
+    f_iota = jnp.arange(bins_t.shape[0], dtype=jnp.int32)[:, None]
+    return jnp.sum(jnp.where(feat_sel[None, :] == f_iota,
+                             bins_t.astype(jnp.int32), 0), axis=0)
 
 
 def reference_histogram(bins, node_id, grad, hess, n_nodes, n_bins):

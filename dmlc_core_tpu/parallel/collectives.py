@@ -44,6 +44,7 @@ from dmlc_core_tpu.base.parameter import get_env
 __all__ = [
     "init", "finalize", "rank", "world_size", "is_distributed",
     "allreduce", "broadcast", "allgather", "barrier",
+    "allreduce_device",
     "device_allreduce", "device_allgather", "device_reduce_scatter",
     "replicate_fwd_psum_bwd",
     "get_tree", "find_share_ring", "get_link_map",
@@ -175,6 +176,47 @@ def barrier(name: str = "dmlc") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+@lru_cache(maxsize=None)
+def _world_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()), ("world",))
+
+
+@lru_cache(maxsize=None)
+def _jitted_world_psum(mesh: Mesh):
+    @partial(shard_map, mesh=mesh, in_specs=P("world"), out_specs=P(),
+             check_vma=False)
+    def _ps(shard):                      # [1, ...] per device
+        return jax.lax.psum(shard[0], "world")
+
+    return jax.jit(_ps)
+
+
+def allreduce_device(x: jax.Array) -> jax.Array:
+    """Sum a per-process DEVICE array across all processes, returning a
+    device array — no host round-trip.
+
+    The fix for the external-memory training loop (BASELINE config 3):
+    per-level page histograms accumulate on device and sync here as one
+    XLA AllReduce over ICI/DCN, where :func:`allreduce` would fetch to
+    host, allgather, and re-reduce in numpy every level.  Each process
+    contributes its value once (staged on its first local device; other
+    local devices contribute zeros), so multi-device processes are safe.
+    """
+    if world_size() == 1:
+        return x
+    mesh = _world_mesh()
+    locals_ = jax.local_devices()
+    x = jnp.asarray(x)
+    shards = [jax.device_put(x[None] if i == 0
+                             else jnp.zeros((1, *x.shape), x.dtype), d)
+              for i, d in enumerate(locals_)]
+    garr = jax.make_array_from_single_device_arrays(
+        (len(jax.devices()), *x.shape),
+        NamedSharding(mesh, P("world")), shards)
+    out = _jitted_world_psum(mesh)(garr)
+    return jnp.asarray(out.addressable_data(0))
 
 
 # ---------------------------------------------------------------------------

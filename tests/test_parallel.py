@@ -369,6 +369,12 @@ WORKER_SCRIPT = textwrap.dedent(
     assert mx[0] == w - 1
     got = coll.broadcast(np.array([7.5]) if r == 0 else np.array([0.0]), root=0)
     assert got[0] == 7.5, got
+    # device-resident allreduce (the external-memory hist-sync path):
+    # result must stay a device array and equal the host-path sum
+    import jax.numpy as jnp
+    dev = coll.allreduce_device(jnp.full((2, 3), float(r + 1)))
+    assert hasattr(dev, "devices"), type(dev)
+    assert np.allclose(np.asarray(dev), expected), np.asarray(dev)
     print(f"worker {r}/{w} OK", flush=True)
     """
 )
