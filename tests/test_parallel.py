@@ -37,6 +37,7 @@ from dmlc_core_tpu.parallel.collectives import (
     get_tree,
 )
 from dmlc_core_tpu.parallel.mesh import local_mesh
+from jax.sharding import NamedSharding, PartitionSpec as P
 from dmlc_core_tpu.tracker.tracker import (
     RabitTracker,
     WorkerSession,
@@ -175,6 +176,51 @@ class TestKVStore:
         a, b = kv.pull(["a", "b"])
         np.testing.assert_allclose(np.asarray(a), 1.0)
         np.testing.assert_allclose(np.asarray(b), 2.0)
+
+    def test_mesh_dist_sync_bucketed_one_collective(self):
+        """Many keys pulled together must fuse into ONE allreduce launch
+        (config 4: per-key launches can't reach bus-bandwidth targets),
+        with results identical to the per-key math."""
+        mesh = local_mesh()
+        W = mesh.devices.size
+        kv = KVStore.create("dist_sync", mesh=mesh, learning_rate=1.0)
+        rng = np.random.default_rng(0)
+        keys = [f"p{i}" for i in range(12)]
+        vals = {k: rng.normal(size=(3 + i % 4,)).astype(np.float32)
+                for i, k in enumerate(keys)}
+        kv.init(list(keys), [vals[k] for k in keys])
+        grads = {k: rng.normal(size=(W, *vals[k].shape)).astype(np.float32)
+                 for k in keys}
+        sharding = NamedSharding(mesh, P("data"))
+        kv.push(list(keys), [jax.device_put(grads[k], sharding)
+                             for k in keys])
+        out = kv.pull(list(keys))
+        assert kv.stats["sync_calls"] == 1, kv.stats
+        assert kv.stats["keys_synced"] == len(keys)
+        for k, o in zip(keys, out):
+            np.testing.assert_allclose(
+                np.asarray(o), vals[k] - grads[k].sum(axis=0),
+                rtol=1e-5, atol=1e-5)
+
+    def test_duplicate_key_in_pull_batch(self):
+        kv = KVStore.create("dist_sync", learning_rate=1.0)
+        kv.init("a", np.zeros(2, np.float32))
+        kv.push("a", np.ones(2, np.float32))
+        o1, o2 = kv.pull(["a", "a"])   # must not KeyError; one sync
+        np.testing.assert_allclose(np.asarray(o1), -1.0)
+        np.testing.assert_allclose(np.asarray(o2), -1.0)
+
+    def test_bucket_cap_splits_collectives(self):
+        mesh = local_mesh()
+        W = mesh.devices.size
+        # 4-byte cap → every key in its own bucket
+        kv = KVStore.create("dist_sync", mesh=mesh, bucket_bytes=4)
+        kv.init(["a", "b", "c"], [np.zeros(2, np.float32)] * 3)
+        sharding = NamedSharding(mesh, P("data"))
+        kv.push(["a", "b", "c"],
+                [jax.device_put(np.ones((W, 2), np.float32), sharding)] * 3)
+        kv.pull(["a", "b", "c"])
+        assert kv.stats["sync_calls"] == 3, kv.stats
 
     def test_uninitialized_key_fatal(self):
         kv = KVStore.create("local")
