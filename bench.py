@@ -27,7 +27,6 @@ pinned from cited public figures, not re-measured here.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 

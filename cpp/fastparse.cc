@@ -124,7 +124,8 @@ bool parse_libsvm_range(const char* begin, const char* end, ThreadRows* tr) {
     if (q < line_end) {
       float lab;
       if (!parse_f32(q, line_end, &lab)) {
-        tr->error = "libsvm: bad label near '" + std::string(q, std::min<int64_t>(line_end - q, 32)) + "'";
+        tr->error = "libsvm: bad label near '" +
+            std::string(q, std::min<int64_t>(line_end - q, 32)) + "'";
         return false;
       }
       int64_t nnz = 0;

@@ -35,7 +35,7 @@ from typing import (
     Union,
 )
 
-from dmlc_core_tpu.base.logging import Error, log_fatal
+from dmlc_core_tpu.base.logging import Error
 
 __all__ = ["Parameter", "field", "FieldEntry", "get_env", "ParamInitOption"]
 
@@ -90,7 +90,9 @@ def _str2type(value: Any, ty: type) -> Any:
             raise ValueError(f"cannot parse {ty.__name__} from {value!r}") from e
     if ty in (list, tuple):
         if isinstance(value, str):
-            items = [v.strip() for v in value.replace("(", "").replace(")", "").split(",") if v.strip()]
+            items = [v.strip() for v in
+                     value.replace("(", "").replace(")", "").split(",")
+                     if v.strip()]
             return ty(items)
         return ty(value)
     return value

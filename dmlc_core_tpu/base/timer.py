@@ -10,7 +10,7 @@ measures nothing).
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
+from typing import Any
 
 __all__ = ["get_time", "Timer", "block_until_ready_time"]
 

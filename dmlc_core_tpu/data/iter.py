@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import Iterator, Optional
 
-from dmlc_core_tpu.base.logging import CHECK, log_fatal
 from dmlc_core_tpu.data.parsers import Parser, parse_uri_spec
 from dmlc_core_tpu.data.row_block import RowBlock, RowBlockContainer
 from dmlc_core_tpu.io.stream import Stream

@@ -20,7 +20,7 @@ from typing import Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
-from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, CHECK_LE, log_fatal
+from dmlc_core_tpu.base.logging import CHECK_EQ, CHECK_LE
 from dmlc_core_tpu.io import serializer as ser
 from dmlc_core_tpu.io.stream import Serializable, Stream
 

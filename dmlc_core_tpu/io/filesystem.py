@@ -18,7 +18,7 @@ import fnmatch as _fnmatch
 import os
 import shutil
 import tempfile
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from dmlc_core_tpu.base.logging import CHECK, log_fatal

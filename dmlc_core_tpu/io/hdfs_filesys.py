@@ -21,10 +21,10 @@ import os
 import urllib.parse
 from typing import List
 
-from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.io.filesystem import FS_REGISTRY, FileInfo, FileSystem, URI
 from dmlc_core_tpu.io.http_util import BufferedWriteStream, RangedReadStream, http_request
-from dmlc_core_tpu.io.stream import SeekStream, Stream
+from dmlc_core_tpu.io.stream import Stream
 
 __all__ = ["HDFSFileSystem"]
 

@@ -61,7 +61,8 @@ class JSONObjectReadHelper:
         return self
 
     def declare_optional_field(self, name: str, ty: Optional[type] = None,
-                               setter: Optional[Callable[[Any], None]] = None) -> "JSONObjectReadHelper":
+                               setter: Optional[Callable[[Any], None]] = None,
+                               ) -> "JSONObjectReadHelper":
         self._fields[name] = (ty, False, setter)
         return self
 

@@ -22,7 +22,7 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
-from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.io.filesystem import FS_REGISTRY, FileInfo, FileSystem, URI
 from dmlc_core_tpu.io.http_util import (
     BufferedWriteStream,

@@ -79,7 +79,8 @@ def read_string(stream: Stream) -> str:
 
 # -- containers ----------------------------------------------------------
 
-def write_vector(stream: Stream, seq: Sequence[Any], write_elem: Callable[[Stream, Any], None]) -> None:
+def write_vector(stream: Stream, seq: Sequence[Any],
+                 write_elem: Callable[[Stream, Any], None]) -> None:
     """uint64 size + elements.  Reference: ``CompositeVectorHandler``."""
     write_uint64(stream, len(seq))
     for item in seq:

@@ -23,7 +23,6 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp

@@ -16,13 +16,13 @@ Reference parity: dmlc-core provides checkpoint *mechanism*, not policy —
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import numpy as np
 
 import jax
 
-from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.io import serializer as ser
 from dmlc_core_tpu.io.stream import Stream
 from dmlc_core_tpu.parallel import collectives as coll

@@ -26,7 +26,6 @@ parity with the reference's coordination brain.
 
 from __future__ import annotations
 
-import atexit
 import os
 from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
@@ -39,7 +38,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
-from dmlc_core_tpu.base.parameter import get_env
 
 __all__ = [
     "init", "finalize", "rank", "world_size", "is_distributed",

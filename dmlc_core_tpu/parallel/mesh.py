@@ -18,7 +18,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, log_fatal
+from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ
 from dmlc_core_tpu.base.parameter import Parameter, field
 
 __all__ = [

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Single-entry CI: reproduces the full green state from a fresh checkout.
+# (The reference ships lint.py + travis/github-actions scripts — SURVEY.md
+# §2d; this is that layer for an image with no external lint tools.)
+#
+#   scripts/ci.sh            # lint + native build + full pytest + sanitizers
+#   scripts/ci.sh quick      # lint + pytest only (no native rebuild/sanitizers)
+#
+# Sanitizer stage: builds the native test binary under ASan/UBSan/TSan and
+# runs the queue/parse/recordio stress suite under each (the reference's
+# CMake USE_SANITIZER story, SURVEY.md §5 race detection).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint =="
+python scripts/lint.py
+
+if [[ "${1:-}" != "quick" ]]; then
+    echo "== native build =="
+    make -C cpp -j"$(nproc)"
+fi
+
+echo "== pytest =="
+python -m pytest tests/ -q -x
+
+if [[ "${1:-}" != "quick" ]]; then
+    echo "== native sanitizers =="
+    scripts/native_sanitize_test.sh
+fi
+
+echo "CI GREEN"

@@ -2,7 +2,6 @@
 config, env.  Mirrors the reference's unittest_logging / unittest_param /
 unittest_config / unittest_env coverage (SURVEY.md §4)."""
 
-import os
 
 import pytest
 

@@ -5,15 +5,12 @@ external-memory hist-GBT over CSR pages.
 import os
 
 import numpy as np
-import jax.numpy as jnp
-import pytest
 
 from dmlc_core_tpu.io.filesystem import TemporaryDirectory
 from dmlc_core_tpu.data.iter import RowBlockIter
 from dmlc_core_tpu.models.histgbt import HistGBT
 from dmlc_core_tpu.ops.quantile import (
     SketchAccumulator,
-    apply_bins,
     compute_cuts,
 )
 
@@ -43,6 +40,108 @@ def _write_libsvm(path, X, y):
             f.write(f"{y[i]:.0f} {feats}\n")
 
 
+def _weighted_rank_interval_error(x, w, cuts, n_bins):
+    """Max distance from each cut's target rank to its achievable rank
+    interval ``[P(X < c), P(X ≤ c)]``.
+
+    Atoms (duplicated values) make the rank set-valued, so interval
+    distance is the honest metric for discrete mass.  Cuts that
+    merge_summaries ε-bumped apart to stay strictly increasing (a run of
+    targets landing on one atom) are scored as ONE cluster at the run's
+    first cut — the bumped copies route rows identically, so they are a
+    representation detail, not sketch error."""
+    order = np.argsort(x, kind="stable")
+    xs, ws = x[order], w[order]
+    cw = np.cumsum(ws)
+    total = cw[-1]
+    target = np.arange(1, n_bins) / n_bins
+    err = 0.0
+    rep = cuts[0]
+    for q, c in zip(target, cuts):
+        tol = max(abs(rep), 1.0) * 1e-6 * (n_bins + 1)
+        if c - rep > tol:
+            rep = c                       # genuinely new cut value
+        lo = np.searchsorted(xs, rep, side="left")
+        hi = np.searchsorted(xs, rep, side="right")
+        r_lo = (cw[lo - 1] if lo > 0 else 0.0) / total
+        r_hi = (cw[hi - 1] if hi > 0 else 0.0) / total
+        if q < r_lo:
+            err = max(err, r_lo - q)
+        elif q > r_hi:
+            err = max(err, q - r_hi)
+    return err
+
+
+def _sketch_eps(n_summary, pages, cap):
+    """The documented bound from ops/quantile.py: (⌈log_C P⌉+4)/(S−1)."""
+    import math
+
+    levels = max(1, math.ceil(math.log(max(pages, 2), cap)))
+    return (levels + 4) / (n_summary - 1)
+
+
+class TestSketchErrorBound:
+    """Adversarial-distribution property tests of the documented
+    eps(S, P, C) rank-error bound (SURVEY.md §7 hard part (c): the
+    reference world's GK sketches carry provable guarantees — so must
+    the fixed-size replacement)."""
+
+    N_BINS = 32
+    S = 512
+    CAP = 4          # tiny buffer → maximal ladder depth for the bound
+
+    def _stream(self, x, w, pages):
+        acc = SketchAccumulator(1, n_summary=self.S, buffer_pages=self.CAP)
+        for xs, ws in zip(np.array_split(x, pages),
+                          np.array_split(w, pages)):
+            acc.add(xs.reshape(-1, 1), ws)
+        cuts = np.asarray(acc.finalize(self.N_BINS))[0]
+        bound = _sketch_eps(self.S, acc.pages_seen, self.CAP)
+        err = _weighted_rank_interval_error(x, w, cuts, self.N_BINS)
+        assert err <= bound, (err, bound)
+        return err, bound
+
+    def test_heavy_tail(self):
+        rng = np.random.default_rng(10)
+        x = rng.pareto(0.5, size=30_000).astype(np.float32)  # infinite mean
+        self._stream(x, np.ones_like(x), pages=37)
+
+    def test_lognormal_wide(self):
+        rng = np.random.default_rng(11)
+        x = np.exp(rng.normal(0, 6, size=30_000)).astype(np.float32)
+        self._stream(x, np.ones_like(x), pages=29)
+
+    def test_near_duplicate_atoms(self):
+        rng = np.random.default_rng(12)
+        x = np.full(30_000, 3.25, np.float32)       # 99.9% one atom
+        idx = rng.choice(len(x), 30, replace=False)
+        x[idx] = rng.normal(size=30).astype(np.float32)
+        self._stream(x, np.ones_like(x), pages=23)
+
+    def test_massive_weight_skew(self):
+        rng = np.random.default_rng(13)
+        x = rng.normal(size=20_000).astype(np.float32)
+        w = np.full_like(x, 1e-6)
+        w[x > 1.5] = 1e6                            # 10^12 dynamic range
+        self._stream(x, w, pages=31)
+
+    def test_sorted_stream_order(self):
+        # pages arrive sorted: every page summarizes a disjoint value
+        # range — the worst case for naive averaging of summaries
+        rng = np.random.default_rng(14)
+        x = np.sort(rng.normal(size=30_000).astype(np.float32))
+        self._stream(x, np.ones_like(x), pages=41)
+
+    def test_many_pages_log_growth(self):
+        # 400 pages through a 4-ary ladder: the flat collapse-all design
+        # would compound ~100 merge stages of error; the ladder stays
+        # within the log-depth bound
+        rng = np.random.default_rng(15)
+        x = rng.normal(size=40_000).astype(np.float32)
+        err, bound = self._stream(x, np.ones_like(x), pages=400)
+        assert bound < 0.02, bound   # the bound itself stays tight
+
+
 class TestSketchAccumulator:
     def test_streaming_matches_full(self):
         X, _ = _synth(20_000, 5)
@@ -62,7 +161,10 @@ class TestSketchAccumulator:
         acc = SketchAccumulator(3, n_summary=64, buffer_pages=4)
         for _ in range(40):
             acc.add(np.random.default_rng(1).normal(size=(100, 3)))
-        assert len(acc._summaries) <= 4  # hierarchical collapse bounds state
+        # C-ary ladder: ≤ C−1 summaries per level, O(log_C P) levels
+        per_level = [len(lv) for lv in acc._levels]
+        assert max(per_level) <= 3, per_level
+        assert len(per_level) <= 4, per_level
 
     def test_weighted(self):
         rng = np.random.default_rng(2)

@@ -19,7 +19,6 @@ from dmlc_core_tpu.io import (
     RecordIOChunkReader,
     RecordIOReader,
     RecordIOWriter,
-    RECORDIO_MAGIC,
     Stream,
     TemporaryDirectory,
     ThreadedIter,
@@ -27,7 +26,7 @@ from dmlc_core_tpu.io import (
 )
 from dmlc_core_tpu.io import serializer as ser
 from dmlc_core_tpu.io.concurrency import QueueKilled
-from dmlc_core_tpu.io.filesystem import FileSystem, MemoryFileSystem
+from dmlc_core_tpu.io.filesystem import MemoryFileSystem
 from dmlc_core_tpu.io.json_io import JSONObjectReadHelper, JSONReader, JSONWriter
 from dmlc_core_tpu.io.recordio import RECORDIO_MAGIC_BYTES
 

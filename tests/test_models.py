@@ -7,10 +7,9 @@ fit on separable synthetic data; sharded-vs-single-device equivalence
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
-from dmlc_core_tpu.models import HistGBT, HistGBTParam
+from dmlc_core_tpu.models import HistGBT
 from dmlc_core_tpu.ops.histogram import build_histogram, reference_histogram
 from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts, local_summary, merge_summaries
 from dmlc_core_tpu.parallel.mesh import local_mesh

@@ -2,10 +2,7 @@
 topology oracle, KVStore, checkpoint, tracker service, local multi-process
 launch (the reference's local.py testing pattern, SURVEY.md §4)."""
 
-import json
 import os
-import socket
-import subprocess
 import sys
 import textwrap
 
@@ -372,7 +369,6 @@ class TestRabitTracker:
         tracker.stop()
 
     def test_garbled_line_is_not_a_death(self):
-        import socket as socket_mod
         tracker = RabitTracker(nworker=1)
         tracker.start()
         w = WorkerSession("127.0.0.1", tracker.port)
@@ -496,7 +492,6 @@ class TestZeroAdam:
     def test_matches_replicated_adam(self):
         """ZeRO-sharded Adam must produce the same trajectory as plain
         replicated Adam on the globally-summed gradients."""
-        from functools import partial
 
         import jax
         from jax import shard_map

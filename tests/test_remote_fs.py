@@ -16,7 +16,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
-from dmlc_core_tpu.io.filesystem import FileSystem, URI
 from dmlc_core_tpu.io.input_split import InputSplit
 from dmlc_core_tpu.io.recordio import encode_records
 from dmlc_core_tpu.io.s3_filesys import sigv4_headers
