@@ -73,10 +73,12 @@ def _weighted_rank_interval_error(x, w, cuts, n_bins):
 
 
 def _sketch_eps(n_summary, pages, cap):
-    """The documented bound from ops/quantile.py: (⌈log_C P⌉+4)/(S−1)."""
-    import math
-
-    levels = max(1, math.ceil(math.log(max(pages, 2), cap)))
+    """The documented bound from ops/quantile.py: (⌈log_C P⌉+4)/(S−1).
+    Integer ladder depth — float log rounds exact powers of C up a level
+    and would silently test a looser bound."""
+    levels = 1
+    while cap ** levels < max(pages, 2):
+        levels += 1
     return (levels + 4) / (n_summary - 1)
 
 
