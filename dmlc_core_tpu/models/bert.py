@@ -42,6 +42,7 @@ from dmlc_core_tpu.parallel.kvstore import KVStore
 from dmlc_core_tpu.parallel.mesh import local_mesh
 from dmlc_core_tpu.ops.attention import local_attention
 from dmlc_core_tpu.parallel.ring_attention import ring_attention
+from dmlc_core_tpu.parallel.moe import moe_ffn
 from dmlc_core_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = ["BERT", "BERTParam"]
@@ -62,6 +63,14 @@ class BERTParam(Parameter):
     sp_method = field(str, default="ring", enum=["ring", "ulysses"],
                       description="sequence-parallel attention: K/V ring "
                                   "rotation vs all-to-all head scatter")
+    ffn_type = field(str, default="dense", enum=["dense", "moe"],
+                     description="dense FFN vs Switch-style top-1 MoE "
+                                 "(experts shard over the 'expert' axis)")
+    n_experts = field(int, default=8, lower_bound=2,
+                      description="experts per MoE layer")
+    capacity_factor = field(float, default=1.25, lower_bound=0.1)
+    moe_aux_weight = field(float, default=0.01, lower_bound=0.0,
+                           description="load-balance aux loss coefficient")
 
 
 def _norm(x, gamma, beta, eps=1e-6):
@@ -95,7 +104,24 @@ class BERT:
         self._tp = self.mesh.shape.get("model", 1)
         self._sp = self.mesh.shape.get("seq", 1)
         self._dp = self.mesh.shape.get("data", 1)
+        self._ep = self.mesh.shape.get("expert", 1)
+        # MoE tokens shard over data×expert (the expert axis doubles as
+        # extra batch parallelism outside the expert dispatch)
+        self._has_expert = "expert" in names and self._ep > 1
         p = self.param
+        self._moe = p.ffn_type == "moe"
+        # MoE shards the batch over data×expert (the expert axis doubles
+        # as extra batch parallelism outside the expert dispatch); a
+        # single definition feeds the input sharding, the step's psum
+        # axes, and the grad sync so they can never disagree
+        self._batch_axes = (("data", "expert")
+                            if self._moe and self._has_expert
+                            else ("data",))
+        if self._moe:
+            CHECK(p.grad_sync == "fused",
+                  "ffn_type='moe' supports grad_sync='fused' only")
+            if self._has_expert:
+                CHECK_EQ(p.n_experts % self._ep, 0, "n_experts % ep != 0")
         CHECK_EQ(p.n_heads % max(self._tp, 1), 0, "n_heads % tp != 0")
         CHECK_EQ(p.d_ff % max(self._tp, 1), 0, "d_ff % tp != 0")
         if p.sp_method == "ulysses" and self._has_seq:
@@ -126,10 +152,18 @@ class BERT:
             specs[f"l{i}.ln2.b"] = P()
             specs[f"l{i}.wqkv"] = P(None, None, mdl, None)      # [3, D, H, Dh]
             specs[f"l{i}.wo"] = P(mdl, None, None)              # [H, Dh, D]
-            specs[f"l{i}.w1"] = P(None, mdl)                    # [D, F]
-            specs[f"l{i}.b1"] = P(mdl)                          # [F]
-            specs[f"l{i}.w2"] = P(mdl, None)                    # [F, D]
-            specs[f"l{i}.b2"] = P()                             # [D]
+            if self._moe:
+                exp = "expert" if self._has_expert else None
+                specs[f"l{i}.wre"] = P()                        # [D, E] router
+                specs[f"l{i}.we1"] = P(exp)                     # [E, D, F]
+                specs[f"l{i}.be1"] = P(exp)                     # [E, F]
+                specs[f"l{i}.we2"] = P(exp)                     # [E, F, D]
+                specs[f"l{i}.be2"] = P(exp)                     # [E, D]
+            else:
+                specs[f"l{i}.w1"] = P(None, mdl)                # [D, F]
+                specs[f"l{i}.b1"] = P(mdl)                      # [F]
+                specs[f"l{i}.w2"] = P(mdl, None)                # [F, D]
+                specs[f"l{i}.b2"] = P()                         # [D]
         return specs
 
     def init_params(self, seed: int = 0) -> None:
@@ -154,10 +188,18 @@ class BERT:
             host[f"l{i}.ln2.b"] = np.zeros(p.d_model, np.float32)
             host[f"l{i}.wqkv"] = g(3, p.d_model, p.n_heads, dh)
             host[f"l{i}.wo"] = g(p.n_heads, dh, p.d_model)
-            host[f"l{i}.w1"] = g(p.d_model, p.d_ff)
-            host[f"l{i}.b1"] = np.zeros(p.d_ff, np.float32)
-            host[f"l{i}.w2"] = g(p.d_ff, p.d_model)
-            host[f"l{i}.b2"] = np.zeros(p.d_model, np.float32)
+            if self._moe:
+                E = p.n_experts
+                host[f"l{i}.wre"] = g(p.d_model, E)
+                host[f"l{i}.we1"] = g(E, p.d_model, p.d_ff)
+                host[f"l{i}.be1"] = np.zeros((E, p.d_ff), np.float32)
+                host[f"l{i}.we2"] = g(E, p.d_ff, p.d_model)
+                host[f"l{i}.be2"] = np.zeros((E, p.d_model), np.float32)
+            else:
+                host[f"l{i}.w1"] = g(p.d_model, p.d_ff)
+                host[f"l{i}.b1"] = np.zeros(p.d_ff, np.float32)
+                host[f"l{i}.w2"] = g(p.d_ff, p.d_model)
+                host[f"l{i}.b2"] = np.zeros(p.d_model, np.float32)
         specs = self._param_specs()
         self.params = {
             k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
@@ -186,6 +228,8 @@ class BERT:
              + lax.dynamic_slice_in_dim(params["pos"], pos0, s_local, 0)[None])
         x = x.astype(jnp.bfloat16)
 
+        aux_total = jnp.float32(0.0)
+
         def join_model(y):
             # Megatron g: psum forward (row-parallel join), identity backward
             return lax.psum(y, "model") if self._has_model else y
@@ -212,20 +256,51 @@ class BERT:
             o = join_model(o)                              # row-parallel join
             x = x + o.astype(jnp.bfloat16)
             h = _norm(x, params[f"l{i}.ln2.g"], params[f"l{i}.ln2.b"])
-            h = enter_model(h)
-            u = jax.nn.gelu(
-                jnp.einsum("bsd,df->bsf", h.astype(jnp.float32),
-                           params[f"l{i}.w1"]) + params[f"l{i}.b1"])
-            m = jnp.einsum("bsf,fd->bsd", u, params[f"l{i}.w2"])
-            m = join_model(m) + params[f"l{i}.b2"]         # row-parallel join
-            x = x + m.astype(jnp.bfloat16)
+            if self._moe:
+                # Switch MoE FFN: runs OUTSIDE the model-parallel region
+                # (replicated over 'model'; experts shard over 'expert')
+                b, s_l, Dm = h.shape
+                y, (a_sum, p_sum, t_cnt) = moe_ffn(
+                    h.astype(jnp.float32).reshape(b * s_l, Dm),
+                    params[f"l{i}.wre"], params[f"l{i}.we1"],
+                    params[f"l{i}.be1"], params[f"l{i}.we2"],
+                    params[f"l{i}.be2"],
+                    axis="expert" if self._has_expert else None,
+                    capacity_factor=p.capacity_factor, stats=True)
+                # routing-statistic SUMS psum over every token-sharding
+                # axis so the aux is computed from GLOBAL expert loads —
+                # exact parity with the unsharded model (a mean of
+                # per-shard aux values is a different statistic)
+                tok_axes = self._batch_axes + (
+                    ("seq",) if self._has_seq else ())
+                a_sum = lax.psum(a_sum, tok_axes)
+                p_sum = lax.psum(p_sum, tok_axes)
+                t_glob = lax.psum(t_cnt, tok_axes)
+                aux_total = aux_total + p.n_experts * jnp.sum(
+                    (a_sum / t_glob) * (p_sum / t_glob))
+                x = x + y.reshape(b, s_l, Dm).astype(jnp.bfloat16)
+            else:
+                h = enter_model(h)
+                u = jax.nn.gelu(
+                    jnp.einsum("bsd,df->bsf", h.astype(jnp.float32),
+                               params[f"l{i}.w1"]) + params[f"l{i}.b1"])
+                m = jnp.einsum("bsf,fd->bsd", u, params[f"l{i}.w2"])
+                m = join_model(m) + params[f"l{i}.b2"]     # row-parallel join
+                x = x + m.astype(jnp.bfloat16)
         x = _norm(x, params["ln_f.g"], params["ln_f.b"])
         logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
                             params["lm_head"])
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_lp = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         mask_f = mask.astype(jnp.float32)
-        return -(tok_lp * mask_f).sum(), mask_f.sum()
+        loss_sum = -(tok_lp * mask_f).sum()
+        if self._moe:
+            # aux_total is GLOBAL (psummed stats) and identical on every
+            # shard; weighting by the local mask sum makes the later
+            # psum/n_glob normalization recover exactly aux_w · aux_total
+            loss_sum = loss_sum + (p.moe_aux_weight
+                                   * aux_total / p.n_layers * mask_f.sum())
+        return loss_sum, mask_f.sum()
 
     def _build_step(self) -> None:
         p = self.param
@@ -237,6 +312,9 @@ class BERT:
         def psum_seq(x):
             return lax.psum(x, "seq") if has_seq else x
 
+        batch_axes = self._batch_axes
+        expert_keys = (".we1", ".be1", ".we2", ".be2")
+
         def step(params, opt_state, tokens, labels, mask):
             def loss_fn(ps):
                 ls, n = self._local_loss(ps, tokens, labels, mask)
@@ -244,15 +322,20 @@ class BERT:
 
             (loss_sum, n_tok), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            n_glob = psum_seq(lax.psum(n_tok, "data"))
+            n_glob = psum_seq(lax.psum(n_tok, batch_axes))
             # normalize to global-mean-per-token gradients
             grads = jax.tree.map(lambda g: g / n_glob, grads)
             # intra-worker seq reduction (model grads are already complete
             # on every shard via the Megatron f/g boundary operators)
             grads = {k: psum_seq(g) for k, g in grads.items()}
-            loss = psum_seq(lax.psum(loss_sum, "data")) / n_glob
+            loss = psum_seq(lax.psum(loss_sum, batch_axes)) / n_glob
             if fused:
-                grads = {k: lax.psum(g, "data") for k, g in grads.items()}
+                # expert-sharded weights already accumulated their expert-
+                # axis contributions through the all_to_all backward; a
+                # psum over 'expert' would double-count them
+                grads = {k: lax.psum(
+                    g, "data" if k.endswith(expert_keys) else batch_axes)
+                    for k, g in grads.items()}
                 # SGD + momentum, f32 master weights
                 new_opt = {k: 0.9 * opt_state[k] + grads[k] for k in grads}
                 new_params = {k: params[k] - lr * new_opt[k] for k in grads}
@@ -263,7 +346,7 @@ class BERT:
             return params, stacked, loss
 
         seq_ax = "seq" if self._has_seq else None
-        batch_spec = P("data", seq_ax)
+        batch_spec = P(batch_axes, seq_ax)
         in_specs = (
             {k: specs[k] for k in specs},
             {k: specs[k] for k in specs},
@@ -294,7 +377,7 @@ class BERT:
             CHECK(0 <= int(np.min(arr)) and int(np.max(arr)) < self.param.vocab_size,
                   f"{name} id out of vocab range [0, {self.param.vocab_size})")
         seq_ax = "seq" if self._has_seq else None
-        sh = NamedSharding(self.mesh, P("data", seq_ax))
+        sh = NamedSharding(self.mesh, P(self._batch_axes, seq_ax))
         t = jax.device_put(np.asarray(tokens, np.int32), sh)
         y = jax.device_put(np.asarray(labels, np.int32), sh)
         m = jax.device_put(np.asarray(mask, np.float32), sh)

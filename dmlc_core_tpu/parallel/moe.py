@@ -46,12 +46,19 @@ def moe_ffn(
     b2: jax.Array,           # [E_local, D]
     axis: Optional[str] = "expert",
     capacity_factor: float = 1.25,
+    stats: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Top-1 expert FFN; returns ``(y [T, D], aux_loss scalar)``.
 
     ``axis=None`` runs the same math unsharded (w1 then holds ALL
     experts) — the single-device reference path and the oracle the
     sharded run is tested against.
+
+    ``stats=True`` returns ``(y, (assign_sum [E], prob_sum [E], T))``
+    instead of the scalar aux: raw routing-statistic SUMS the caller can
+    psum over its batch axes and combine into the aux loss GLOBALLY —
+    the only way a data-sharded trainer reproduces the unsharded aux
+    exactly (a mean of per-shard aux values is a different statistic).
     """
     T, D = x.shape
     E = wr.shape[1]
@@ -66,7 +73,9 @@ def moe_ffn(
 
     onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)  # [T, E]
     # aux load-balance loss (Switch eq. 4): E · Σ_e fraction_e · prob_e
-    aux = E * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+    assign_sum = jnp.sum(onehot, axis=0)                  # [E]
+    prob_sum = jnp.sum(probs, axis=0)                     # [E]
+    aux = E * jnp.sum((assign_sum / T) * (prob_sum / T))
     pos = (jnp.cumsum(onehot, axis=0) * onehot).astype(jnp.int32)  # 1-based
     keep = (pos > 0) & (pos <= cap)
     slot = jax.nn.one_hot(pos - 1, cap, dtype=x.dtype) * keep[..., None]
@@ -89,6 +98,8 @@ def moe_ffn(
                             tiled=False)
         ye = ye.reshape(E, cap, D)
     y = jnp.einsum("tec,ecd->td", dispatch, ye) * gate[:, None]
+    if stats:
+        return y, (assign_sum, prob_sum, jnp.float32(T))
     return y, aux
 
 

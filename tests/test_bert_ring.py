@@ -104,6 +104,74 @@ class TestBERT:
                  vocab_size=32, max_len=16)
 
 
+class TestBERTMoE:
+    """ffn_type='moe': expert-parallel Switch FFN inside the BERT stack.
+
+    Oracle: a dp×ep mesh must track the unsharded single-device run
+    exactly (same params, same tokens — the all_to_all dispatch and the
+    expert-axis grad bookkeeping must not change the math)."""
+
+    KW = dict(n_layers=2, d_model=32, n_heads=4, d_ff=64, vocab_size=64,
+              max_len=16, learning_rate=0.1, ffn_type="moe", n_experts=4,
+              capacity_factor=8.0)
+
+    @pytest.mark.parametrize("partial_mask", [False, True])
+    def test_ep_matches_unsharded(self, partial_mask):
+        """dp×ep must track the unsharded run exactly — including under
+        PARTIAL masks, where the aux must weight routing stats by tokens
+        routed, not loss positions (it is computed from globally psummed
+        stats).  Capacity is loose here: the drop RULE is per dispatch
+        group by design (see test_capacity_pressure_sharded)."""
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        if partial_mask:
+            # skewed density: first half of the batch mostly masked-in,
+            # second half mostly masked-out — exactly the case where a
+            # mask-weighted LOCAL aux diverges from the global aux
+            mask = (rng.random((8, 16)) <
+                    np.linspace(0.9, 0.1, 8)[:, None]).astype(np.float32)
+        else:
+            mask = np.ones((8, 16), np.float32)
+        mesh = create_mesh(MeshSpec(data=2, expert=2),
+                           devices=jax.devices()[:4])
+        m1 = BERT(mesh=mesh, **self.KW)
+        m1.init_params(0)
+        m0 = BERT(mesh=Mesh(np.asarray(jax.devices()[:1]), ("data",)),
+                  **self.KW)
+        m0.init_params(0)
+        losses = []
+        for _ in range(4):
+            l1 = m1.train_step(tokens, tokens.copy(), mask)
+            l0 = m0.train_step(tokens, tokens.copy(), mask)
+            assert abs(l1 - l0) < 2e-4, (l1, l0)
+            losses.append(l1)
+        if not partial_mask:
+            assert losses[-1] < losses[0] - 0.1   # and it learns
+
+    def test_capacity_pressure_sharded(self):
+        """Under capacity pressure exact sharded/unsharded parity is NOT
+        a contract: capacity binds per dispatch group (each token shard
+        keeps its first cap-per-expert tokens — standard Switch), so the
+        surviving sets differ.  The contract is: training stays finite
+        and learns."""
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        mask = np.ones((8, 16), np.float32)
+        mesh = create_mesh(MeshSpec(data=2, expert=2),
+                           devices=jax.devices()[:4])
+        m = BERT(mesh=mesh, **{**self.KW, "capacity_factor": 1.0})
+        m.init_params(0)
+        losses = [m.train_step(tokens, tokens.copy(), mask)
+                  for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] - 0.05, losses
+
+    def test_moe_requires_fused_sync(self):
+        from dmlc_core_tpu.base.logging import Error
+        with pytest.raises(Error):
+            BERT(grad_sync="kvstore", **{**self.KW, "learning_rate": 0.1})
+
+
 class TestUlysses:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_full_softmax(self, causal, rng):
