@@ -105,8 +105,6 @@ class BERT:
         self._sp = self.mesh.shape.get("seq", 1)
         self._dp = self.mesh.shape.get("data", 1)
         self._ep = self.mesh.shape.get("expert", 1)
-        # MoE tokens shard over data×expert (the expert axis doubles as
-        # extra batch parallelism outside the expert dispatch)
         self._has_expert = "expert" in names and self._ep > 1
         p = self.param
         self._moe = p.ffn_type == "moe"

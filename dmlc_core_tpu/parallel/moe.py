@@ -26,7 +26,7 @@ prob, Switch eq. 4) is returned so trainers can keep routing uniform.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -47,7 +47,7 @@ def moe_ffn(
     axis: Optional[str] = "expert",
     capacity_factor: float = 1.25,
     stats: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, Any]:
     """Top-1 expert FFN; returns ``(y [T, D], aux_loss scalar)``.
 
     ``axis=None`` runs the same math unsharded (w1 then holds ALL
