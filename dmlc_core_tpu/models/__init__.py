@@ -15,3 +15,4 @@ and carrying the benchmarks:
 from dmlc_core_tpu.models.histgbt import HistGBT, HistGBTParam  # noqa: F401
 from dmlc_core_tpu.models.resnet import ResNet, ResNetParam, ResNetTrainer  # noqa: F401
 from dmlc_core_tpu.models.bert import BERT, BERTParam  # noqa: F401
+from dmlc_core_tpu.models.fm import FM, FMParam  # noqa: F401
