@@ -9,7 +9,8 @@ and carrying the benchmarks:
 * :mod:`resnet` — image trainer fed by the RecordIO infeed pipeline
   (BASELINE config 2).
 * :mod:`bert` — transformer encoder trained with KVStore-shaped gradient
-  sync (BASELINE config 4).
+  sync (BASELINE config 4); dense or Switch-MoE FFN over the expert axis.
+* :mod:`fm` — factorization machines, the LibFM-format consumer.
 """
 
 from dmlc_core_tpu.models.histgbt import HistGBT, HistGBTParam  # noqa: F401

@@ -1,0 +1,51 @@
+"""Factorization machine on a .libfm file (the LibFM-parser consumer).
+
+Writes a synthetic field-aware dataset in LibFM format (``label
+field:index:value ...``), then trains a second-order FM through the full
+data plane: Parser → RowBlockIter → per-page dense batches → jitted
+data-parallel Adam steps.
+
+Run: python examples/fm_libfm.py
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_core_tpu.data.iter import RowBlockIter
+from dmlc_core_tpu.models import FM
+
+
+def write_libfm(path, n=20_000, F=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    # purely pairwise signal — a linear model cannot fit this
+    y = (1.5 * X[:, 0] * X[:, 1] - 2.0 * X[:, 2] * X[:, 3] > 0)
+    with open(path, "w") as f:
+        for i in range(n):
+            feats = " ".join(f"{j % 4}:{j}:{X[i, j]:.6f}" for j in range(F))
+            f.write(f"{int(y[i])} {feats}\n")
+    return X, y.astype(np.float32)
+
+
+def main():
+    root = tempfile.mkdtemp()
+    path = os.path.join(root, "train.libfm")
+    X, y = write_libfm(path)
+
+    model = FM(n_factors=8, n_epochs=20, learning_rate=0.1,
+               batch_size=4096)
+    it = RowBlockIter.create(path, 0, 1, "libfm")
+    model.fit_iter(it)
+    it.close()
+
+    acc = float(((model.predict(X) > 0.5) == (y > 0.5)).mean())
+    print(f"train accuracy {acc:.3f} in {model.last_fit_seconds:.1f}s "
+          f"({model.param.n_epochs} epochs)")
+
+
+if __name__ == "__main__":
+    main()
