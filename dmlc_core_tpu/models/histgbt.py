@@ -378,6 +378,15 @@ class HistGBT:
         self.trees: List[Dict[str, np.ndarray]] = []   # per-tree arrays
         self._round_fn = None
         self.last_fit_seconds: Optional[float] = None
+        #: per-chunk timing evidence (bench.py auditability): _boost_binned
+        #: records (rounds_fetched, seconds_since_t0) as each dispatch
+        #: chunk's trees arrive on host, so a degraded remote tunnel (one
+        #: slow dispatch) is distinguishable from a slow steady state —
+        #: the round-2 BENCH capture was 68× off with no way to tell.
+        #: Timestamps ride the tree-fetch loop that already exists, so
+        #: recording adds no device traffic and no pipeline break.
+        self.last_chunk_times: List[Tuple[int, float]] = []
+        self.last_warmup_seconds: Optional[float] = None
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
         self._early_stopped = False
@@ -571,6 +580,7 @@ class HistGBT:
         kfn = self._build_round_fn(n_features, K)
         rem = p.n_trees % K
         rem_fn = self._build_round_fn(n_features, rem) if rem else None
+        t_w = get_time()
         if warmup_rounds > 0:
             # compile + cache-warm on a copy so the real buffer stays
             # valid and model state is untouched (preds is donated).
@@ -582,6 +592,7 @@ class HistGBT:
                 warm = run(rem_fn, jnp.copy(preds), 0)
                 np.asarray(warm[0][:1])
         np.asarray(preds[:1])
+        self.last_warmup_seconds = get_time() - t_w
 
         t0 = get_time()
         chunks: List[Any] = []
@@ -596,9 +607,17 @@ class HistGBT:
                 LOG("INFO", "round %d: loss=%.5f", done, loss)
             if after_chunk is not None and after_chunk(done, preds, trees_k):
                 break
-        for trees_k in chunks:            # ONE host fetch per chunk
+        self.last_chunk_times = []
+        fetched = 0
+        for trees_k in chunks:            # ONE host fetch per chunk.
+            # Chunk i's trees arrive only once dispatch i finishes, while
+            # later chunks keep computing — so these in-order arrival
+            # timestamps give per-chunk durations for free (see
+            # ``last_chunk_times`` doc in __init__).
             t_np = jax.tree.map(np.asarray, trees_k)
             k = t_np["leaf"].shape[0]
+            fetched += k
+            self.last_chunk_times.append((fetched, get_time() - t0))
             self.trees.extend(
                 {key: t_np[key][i] for key in t_np} for i in range(k))
         np.asarray(preds[:1])             # real sync before stopping timer
@@ -840,6 +859,10 @@ class HistGBT:
                 loss = obj.finalize_mean_loss(num / max(den, 1))
                 LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
         self.last_fit_seconds = get_time() - t0
+        # the page loop has no dispatch chunks; stale evidence from an
+        # earlier in-core fit must not describe this run
+        self.last_chunk_times = []
+        self.last_warmup_seconds = None
         return self
 
     def _fit_external_cached(self, pages, F: int, eval_every: int,
