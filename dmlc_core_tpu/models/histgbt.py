@@ -863,6 +863,11 @@ class HistGBT:
         # earlier in-core fit must not describe this run
         self.last_chunk_times = []
         self.last_warmup_seconds = None
+        # same staleness rule for prediction state: the page loop keeps
+        # margins per page, not as one train-order vector, so a previous
+        # fit's _train_preds must not answer train_margins() for this one
+        self._train_preds = None
+        self._n_real_rows = None
         return self
 
     def _fit_external_cached(self, pages, F: int, eval_every: int,
@@ -905,9 +910,14 @@ class HistGBT:
             NamedSharding(self.mesh, P("data", None))
             if p.num_class > 1 else row_sharding)
 
-        self._boost_binned(bins_t, y_d, w_d, preds, F,
-                           eval_every=eval_every,
-                           warmup_rounds=warmup_rounds)
+        preds = self._boost_binned(bins_t, y_d, w_d, preds, F,
+                                   eval_every=eval_every,
+                                   warmup_rounds=warmup_rounds)
+        # same post-fit contract as fit(): train_margins() works after a
+        # cache_device external fit too (padding sliced off by the
+        # recorded real-row count)
+        self._train_preds = preds
+        self._n_real_rows = n
         return self
 
     # ------------------------------------------------------------------
@@ -1187,8 +1197,14 @@ class HistGBT:
         return np.stack([1.0 - prob1, prob1], axis=1)
 
     def train_margins(self) -> np.ndarray:
-        """Raw training-set margins after fit (real rows only)."""
-        CHECK(hasattr(self, "_train_preds"), "call fit first")
+        """Raw training-set margins after fit (real rows only).
+
+        Available after :meth:`fit` and ``fit_external(cache_device=
+        True)``; the page-loop external path keeps margins per page and
+        clears this state (stale-evidence rule in fit_external)."""
+        CHECK(getattr(self, "_train_preds", None) is not None,
+              "call fit first (train_margins is unavailable after a "
+              "cache_device=False external fit)")
         return np.asarray(self._train_preds)[: self._n_real_rows]
 
     def _margin_shape(self, n: int) -> Tuple[int, ...]:

@@ -20,6 +20,7 @@ the between-step host API.
 
 from __future__ import annotations
 
+import hashlib
 from functools import lru_cache
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -29,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.base.parameter import get_env
 from dmlc_core_tpu.parallel import collectives as coll
 
 __all__ = ["KVStore"]
@@ -159,6 +161,25 @@ class KVStore:
         in_mesh = self._mesh is not None
         if not in_mesh and coll.world_size() <= 1:
             return grads
+        if not in_mesh and get_env("DMLC_KVSTORE_CHECK", 0, int):
+            # Fused pull is only correct when every worker pulls the
+            # identical key batch in the identical order (the documented
+            # dist_sync contract); a skewed batch would silently
+            # concatenate mismatched buckets and corrupt every gradient
+            # in them.  Under the debug flag, cross-check a digest of the
+            # (key, shape, dtype) sequence before reducing: two tiny
+            # collectives, fail-fast on divergence.
+            sig = repr([(str(k), tuple(jnp.asarray(grads[k]).shape),
+                         str(jnp.asarray(grads[k]).dtype)) for k in grads])
+            h = np.array([int.from_bytes(
+                hashlib.sha1(sig.encode()).digest()[:8], "big") >> 1],
+                np.int64)
+            if (coll.allreduce(h, "min")[0] != coll.allreduce(h, "max")[0]):
+                log_fatal(
+                    "KVStore dist_sync: workers pulled DIFFERENT key "
+                    f"batches (rank {coll.rank()} batch signature differs); "
+                    "fused bucketing requires identical pull order on "
+                    f"every worker. Local batch: {sig[:500]}")
         out: Dict[Key, jax.Array] = {}
 
         def flush(bucket: List[Key]) -> None:

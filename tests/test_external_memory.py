@@ -324,3 +324,10 @@ def test_cache_device_matches_default(tmp_path):
         # page loop's segment_sum — identical splits, ~1e-4 leaf drift
         np.testing.assert_allclose(t0["leaf"], t1["leaf"],
                                    rtol=1e-3, atol=1e-5)
+    # post-fit contract parity with fit(): the cached path must leave
+    # train_margins() usable (real rows only, padding sliced off)
+    tm = models[True].train_margins()
+    assert tm.shape[0] == len(y)
+    np.testing.assert_allclose(
+        tm, models[True].predict(X, output_margin=True), rtol=1e-4,
+        atol=1e-5)

@@ -49,6 +49,28 @@ class TestHistogram:
         ref = reference_histogram(bins, node, g, h, N, B)
         np.testing.assert_allclose(out, ref, atol=2e-2, rtol=1e-2)  # bf16 dot
 
+    def test_pallas_subtile_packing(self, rng, monkeypatch):
+        # S>1 subtile packing (ops/histogram.py _pack_factor) is disabled
+        # on v5e (measured slower) but the plumbing is a documented seam
+        # for other hardware — keep it correct: force pack=2 and check
+        # the packed kernel against the numpy oracle in interpret mode.
+        # tile_rows=256 is a unique static arg so the jit cache can't
+        # serve a pack=1 trace from another test.
+        import dmlc_core_tpu.ops.histogram as H
+
+        monkeypatch.setattr(H, "_pack_factor", lambda n_nodes, n_bins: 2)
+        n, F, B, N = 700, 3, 128, 2    # pad path + 3 partial tiles
+        bins = rng.integers(0, B, size=(n, F)).astype(np.int32)
+        node = rng.integers(0, N, size=n).astype(np.int32)
+        node[::7] = -1                 # masked rows must drop out
+        g = rng.normal(size=n).astype(np.float32)
+        h = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+        out = np.asarray(H._hist_pallas(
+            jnp.asarray(bins), jnp.asarray(node), jnp.asarray(g),
+            jnp.asarray(h), N, B, 256))
+        ref = reference_histogram(bins, node, g, h, N, B)
+        np.testing.assert_allclose(out, ref, atol=2e-2, rtol=1e-2)
+
     def test_fused_descend_matches_two_pass(self, rng):
         # the fused Pallas descend+histogram (off by default on v5e, env
         # knob DMLC_TPU_FUSED_DESCEND) must stay in lockstep with the

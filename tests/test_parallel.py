@@ -207,6 +207,38 @@ class TestKVStore:
         np.testing.assert_allclose(np.asarray(o1), -1.0)
         np.testing.assert_allclose(np.asarray(o2), -1.0)
 
+    def test_dist_sync_batch_digest_check(self, monkeypatch):
+        """DMLC_KVSTORE_CHECK=1 cross-checks that every worker pulled the
+        same (key, shape, dtype) batch before fused reduction; a skewed
+        batch must fail fast instead of silently corrupting gradients.
+        Simulated two-worker world: allreduce echo = digests agree;
+        perturbed max = digests differ -> fatal."""
+        from dmlc_core_tpu.parallel import kvstore as kvmod
+
+        monkeypatch.setenv("DMLC_KVSTORE_CHECK", "1")
+        monkeypatch.setattr(kvmod.coll, "world_size", lambda: 2)
+        calls = []
+
+        def echo_allreduce(x, op="sum"):
+            calls.append(op)
+            return np.asarray(x)
+
+        monkeypatch.setattr(kvmod.coll, "allreduce", echo_allreduce)
+        kv = KVStore.create("dist_sync", learning_rate=1.0)
+        kv.init("w", np.zeros(2, np.float32))
+        kv.push("w", np.ones(2, np.float32))
+        kv.pull("w")                      # identical batches: passes
+        assert calls[:2] == ["min", "max"]
+
+        def skewed_allreduce(x, op="sum"):
+            x = np.asarray(x)
+            return x + 1 if op == "max" else x   # min != max -> divergence
+
+        monkeypatch.setattr(kvmod.coll, "allreduce", skewed_allreduce)
+        kv.push("w", np.ones(2, np.float32))
+        with pytest.raises(Error, match="DIFFERENT key batches"):
+            kv.pull("w")
+
     def test_bucket_cap_splits_collectives(self):
         mesh = local_mesh()
         W = mesh.devices.size
