@@ -351,6 +351,37 @@ class BERT:
             batch_spec, batch_spec, batch_spec,
         )
         if fused:
+            # scan-chunked multi-step program (fit_chunked): K optimizer
+            # steps per dispatch.  Per-dispatch + fetch latency through a
+            # remote-device tunnel is hundreds of ms — a per-step host
+            # loop (train_step's float(loss)) would swamp a ~50ms
+            # BERT-base step 5-10x, the same trap the hist-GBT round loop
+            # solved with lax.scan chunks.
+            self._multi_cache: dict = {}
+
+            def make_multi(K: int):
+                if K not in self._multi_cache:
+                    def multi(params, opt_state, tokens, labels, mask):
+                        def body(carry, _):
+                            ps, os_ = carry
+                            p2, o2, loss = step(ps, os_, tokens, labels,
+                                                mask)
+                            return (p2, o2), loss
+
+                        (p2, o2), losses = lax.scan(
+                            body, (params, opt_state), None, length=K)
+                        return p2, o2, losses
+
+                    mapped_k = shard_map(
+                        multi, mesh=self.mesh, in_specs=in_specs,
+                        out_specs=({k: specs[k] for k in specs},
+                                   {k: specs[k] for k in specs}, P()),
+                        check_vma=False)
+                    self._multi_cache[K] = jax.jit(
+                        mapped_k, donate_argnums=(0, 1))
+                return self._multi_cache[K]
+
+            self._make_multi = make_multi
             out_specs = ({k: specs[k] for k in specs},
                          {k: specs[k] for k in specs}, P())
         else:
@@ -407,3 +438,50 @@ class BERT:
             loss = self.train_step(tokens, labels, mask)
         jax.block_until_ready(self.params["embed"])
         return loss, get_time() - t0
+
+    def fit_chunked(self, tokens: np.ndarray, labels: np.ndarray,
+                    mask: np.ndarray, n_steps: int, chunk: int = 10,
+                    warmup_chunks: int = 1):
+        """Bench harness for remote-tunnel devices: run ``n_steps`` fused
+        optimizer steps as ``lax.scan`` chunks of ``chunk`` per dispatch
+        (per-step host sync would dominate the measurement — see
+        _build_step).  Returns ``(final_loss, seconds, chunk_times)``
+        where chunk_times are in-order (steps_done, t) loss-fetch arrival
+        timestamps — the same per-chunk audit evidence bench.py records
+        for hist-GBT.  Timed region covers steady state only (warmup
+        chunks compile + cache-warm first).  Requires grad_sync='fused'."""
+        CHECK(self.params is not None, "call init_params() first")
+        CHECK(self.param.grad_sync == "fused",
+              "fit_chunked needs grad_sync='fused' (kvstore sync is a "
+              "host round-trip per step by design)")
+        seq_ax = "seq" if self._has_seq else None
+        sh = NamedSharding(self.mesh, P(self._batch_axes, seq_ax))
+        t = jax.device_put(np.asarray(tokens, np.int32), sh)
+        y = jax.device_put(np.asarray(labels, np.int32), sh)
+        m = jax.device_put(np.asarray(mask, np.float32), sh)
+        CHECK(n_steps % chunk == 0,
+              f"n_steps {n_steps} must be a multiple of chunk {chunk} "
+              "(the scan program runs whole chunks; a silent overshoot "
+              "would corrupt steps/s math in callers)")
+        fn = self._make_multi(chunk)
+        for _ in range(max(warmup_chunks, 1)):
+            self.params, self.opt_state, losses = fn(
+                self.params, self.opt_state, t, y, m)
+        np.asarray(losses[-1:])       # real fetch = warmup completion
+        t0 = get_time()
+        loss_chunks = []
+        done = 0
+        while done < n_steps:
+            self.params, self.opt_state, losses = fn(
+                self.params, self.opt_state, t, y, m)
+            loss_chunks.append(losses)
+            done += chunk
+        chunk_times = []
+        fetched = 0
+        final_loss = float("nan")
+        for losses in loss_chunks:    # in-order arrival timestamps
+            arr = np.asarray(losses)
+            fetched += len(arr)
+            chunk_times.append((fetched, get_time() - t0))
+            final_loss = float(arr[-1])
+        return final_loss, get_time() - t0, chunk_times
