@@ -54,7 +54,10 @@ def histogram_methods() -> list[str]:
     return ["auto", "segment", "matmul", "pallas"]
 
 
-_TILE_ROWS = 8192  # pallas row-tile; v5e sweep: ~3-8% over 4096 at all levels
+#: pallas row-tile.  v5e sweeps: 8192 beat 4096 by 3-8% (round 2, 4M
+#: rows); 16384 beats 8192 at the north-star 10M shape at most levels
+#: (L0/L2/L3/L5 by 5-25%, L1/L4 within noise) — scripts/sweep_hist.py.
+_TILE_ROWS = 16384
 
 
 def _pack_factor(n_nodes: int, n_bins: int) -> int:
@@ -68,22 +71,30 @@ def _pack_factor(n_nodes: int, n_bins: int) -> int:
 
 
 def _pallas_ok(n_bins: int, n_features: int, n_nodes: int = 1,
-               bins_itemsize: int = 1) -> bool:
-    """The factored kernel works for any n_bins; the binding constraint is
-    the [Fp, S·A, lo] f32 accumulator block.  Empirically calibrated on
-    v5e at tile_rows=4096: nominal accumulators up to 32MB compile and
-    run (Mosaic windows the out block; fori_loop temporaries are reused,
-    so per-row working-set formulas wildly overestimate), 64MB fails —
-    the 24MB budget keeps a safety margin below the measured boundary.
-    The [Fp, R] bins input block scales with the bin dtype
-    (``bins_itemsize``): uint8 from apply_bins, int32 for >256 bins."""
+               bins_itemsize: int = 1, tile_rows: int = 0) -> bool:
+    """The factored kernel works for any n_bins; the binding constraints
+    are (a) the [Fp, S·A, lo] f32 accumulator block — empirically
+    calibrated on v5e at tile_rows=4096: nominal accumulators up to 32MB
+    compile and run (Mosaic windows the out block; fori_loop temporaries
+    are reused, so per-row working-set formulas wildly overestimate),
+    64MB fails, 24MB keeps margin — and (b) the tile-scaled VMEM stack:
+    per row-tile of T rows the kernel holds the [Fp, T] bins block, the
+    int32 prep ([8,T] blk/t0s/los), the per-feature one-hots (oh [nh,T] +
+    lhs [2nh,T] bf16, rhs [lo,T] bf16) and ~6 [1,T] i32/f32 vectors —
+    ≈ T·(Fp·itemsize + 120 + 6·nh + 2·lo) bytes.  Calibration anchor:
+    tile 65536 at lo=32, nh=8, Fp=32 predicts 17.3MB and measurably
+    OOMs the 16MB scoped-vmem limit (sweep_hist, 10M rows); tile 16384
+    at the deepest default level predicts 9.8MB and runs.  The 15MB
+    budget keeps margin under the measured 16MB wall."""
     lo = _lo_factor(n_nodes, n_bins)
     hi = -(-n_bins // lo)
     fp = -(-n_features // 8) * 8
-    sa = _pack_factor(n_nodes, n_bins) * 2 * n_nodes * hi
+    nh = n_nodes * hi
+    sa = _pack_factor(n_nodes, n_bins) * 2 * nh
     acc = fp * sa * max(lo, 128) * 4
-    bins_tile = fp * _TILE_ROWS * bins_itemsize
-    return acc <= 24 << 20 and bins_tile <= 8 << 20
+    T = tile_rows or _TILE_ROWS
+    tile_stack = T * (fp * bins_itemsize + 120 + 6 * nh + 2 * lo)
+    return acc <= 24 << 20 and tile_stack <= 15 << 20
 
 
 def build_histogram(
@@ -327,13 +338,23 @@ def _fused_kernel(bins_ref, node_ref, feat_ref, thr_ref, g_ref, h_ref,
                 n_nodes=n_prev, hi=hi, lo=lo, pack=pack)
 
 
+#: measured-best lo per n_build at n_bins=256 on v5e, tile 16384, 10M
+#: rows (scripts/sweep_hist.py, 48-config sweep): the analytic 5A+2lo
+#: model below agrees except n_build=2, where hardware prefers 32 over
+#: the model's 64 (12.7 vs 14.9 ms).
+_LO_MEASURED_256 = {1: 32, 2: 32, 4: 64, 8: 128, 16: 128}
+
+
 def _lo_factor(n_nodes: int, n_bins: int) -> int:
     """Bin-factor split ``bin = hi·lo + lo_part``.  MXU work A·lo =
     2·N·n_bins is invariant in ``lo``, but the per-feature construction
     is ~c₁·A (LHS one-hots) + c₂·lo (RHS one-hot), so small ``lo``
-    trades RHS compare traffic for LHS height.  v5e measurements (4M
-    rows, 28 features) put the knee at lo=32 for shallow levels; deeper
-    levels (A ≥ 64 at lo=128) prefer the classic 128."""
+    trades RHS compare traffic for LHS height.  At the default
+    n_bins=256 the choice is pinned by measurement (sweep table above);
+    other bin counts fall back to the op-count model, whose knee matched
+    v5e hardware at every level except one."""
+    if n_bins == 256 and n_nodes in _LO_MEASURED_256:
+        return _LO_MEASURED_256[n_nodes]
     best, best_cost = 128, None
     for lo in (32, 64, 128):
         if lo > max(n_bins, 8):
