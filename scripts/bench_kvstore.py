@@ -77,6 +77,13 @@ def main() -> None:
         rng.normal(size=(W, *s)).astype(np.float32) / W, sharding1)
         for k, s in shapes}
 
+    # BASELINE config 4's target line is BUS BANDWIDTH: for a ring-style
+    # allreduce of S bytes over n workers every worker moves
+    # 2·(n-1)/n · S bytes over its links (the NCCL busbw convention), so
+    # achieved bus GB/s = that / sync seconds.  Meaningless at W=1 (the
+    # psum is a no-op) → null.
+    bus_bytes = 2 * (W - 1) / W * n_params * 4
+
     for label, bucket_bytes in (("per-key", 1), ("bucketed", 64 << 20)):
         kv = KVStore.create("dist_sync", mesh=mesh, learning_rate=0.01,
                             bucket_bytes=bucket_bytes)
@@ -85,6 +92,18 @@ def main() -> None:
         # warm the jit caches
         kv.push([k for k, _ in shapes], [grads[k] for k, _ in shapes])
         kv.pull([k for k, _ in shapes])
+
+        # sync-only timing (the collective itself, no SGD update): the
+        # number the bus-bandwidth target compares against
+        flat_grads = {k: grads[k] for k, _ in shapes}
+        sync_out = kv._sync_bucketed(dict(flat_grads))     # warm
+        jax.block_until_ready(list(sync_out.values()))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            sync_out = kv._sync_bucketed(dict(flat_grads))
+        jax.block_until_ready(list(sync_out.values()))
+        dt_sync = (time.perf_counter() - t0) / steps
+
         kv.stats = {"sync_calls": 0, "keys_synced": 0}
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -100,6 +119,13 @@ def main() -> None:
             "collectives_per_step": kv.stats["sync_calls"] // steps,
             "steps_per_sec": round(steps / dt, 3),
             "grad_mb_per_step": round(n_params * 4 / 1e6, 1),
+            "sync_ms": round(dt_sync * 1e3, 2),
+            "allreduce_bus_mb_per_step": round(bus_bytes / 1e6, 1),
+            "bus_gbps": (round(bus_bytes / dt_sync / 1e9, 3)
+                         if W > 1 else None),
+            "bus_gbps_incl_update": (round(bus_bytes * steps / dt / 1e9, 3)
+                                     if W > 1 else None),
+            "platform": jax.devices()[0].platform,
         }))
 
 

@@ -12,6 +12,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== hygiene =="
+# setuptools bdist leftovers duplicate the package on disk (build/lib is
+# a full copy of dmlc_core_tpu) — they double naive LoC counts and can
+# shadow the real package in tooling; keep only the native outputs
+rm -rf build/lib build/bdist.* ./*.egg-info
+
 echo "== lint =="
 python scripts/lint.py
 
