@@ -211,6 +211,46 @@ class BERT:
             for k in self.params:
                 self._kv.init(k, self.params[k])
 
+    # -- checkpointing (Stream/serializer consumer layer) ---------------
+    _MODEL_MAGIC = b"DMLCTPU.BERT.v1\n"
+
+    def save_model(self, uri: str) -> None:
+        """Serialize hyperparams + params + momentum to any Stream URI
+        (SURVEY.md §5 checkpoint layering; see models/checkpoint.py)."""
+        from dmlc_core_tpu.models.checkpoint import gather_tree, save_payload
+
+        CHECK(self.params is not None, "save_model before init_params")
+        save_payload(uri, self._MODEL_MAGIC, {
+            "param": self.param.to_dict(),
+            "params": gather_tree(self.params),
+            "opt_state": gather_tree(self.opt_state),
+        })
+
+    @classmethod
+    def load_model(cls, uri: str, mesh: Optional[Mesh] = None) -> "BERT":
+        """Inverse of :meth:`save_model`: params re-shard onto ``mesh``
+        via this model's own PartitionSpecs; training resumes exactly
+        (momentum restored)."""
+        from dmlc_core_tpu.models.checkpoint import load_payload
+
+        payload = load_payload(uri, cls._MODEL_MAGIC)
+        model = cls(mesh=mesh, **payload["param"])
+        specs = model._param_specs()
+        model.params = {
+            k: jax.device_put(v, NamedSharding(model.mesh, specs[k]))
+            for k, v in payload["params"].items()}
+        model.opt_state = {
+            k: jax.device_put(v, NamedSharding(model.mesh, specs[k]))
+            for k, v in payload["opt_state"].items()}
+        model._build_step()
+        if model.param.grad_sync == "kvstore":
+            model._kv = KVStore.create(
+                "dist_sync", learning_rate=model.param.learning_rate,
+                mesh=model.mesh, axis="data")
+            for k in model.params:
+                model._kv.init(k, model.params[k])
+        return model
+
     # -- forward/backward under shard_map ------------------------------
     def _local_loss(self, params, tokens, labels, mask):
         """Per-device forward: tokens [b, s_local] → (loss_sum, n_tokens).

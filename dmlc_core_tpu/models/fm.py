@@ -228,6 +228,45 @@ class FM:
         self.last_fit_seconds = get_time() - t0
         return self
 
+    # -- checkpointing (Stream/serializer consumer layer) ---------------
+    _MODEL_MAGIC = b"DMLCTPU.FM.v1\n"
+
+    def save_model(self, uri: str) -> None:
+        """Serialize hyperparams + weights + Adam state to any Stream
+        URI (SURVEY.md §5 checkpoint layering)."""
+        from dmlc_core_tpu.models.checkpoint import gather_tree, save_payload
+
+        CHECK(self.params is not None, "save_model before fit")
+        save_payload(uri, self._MODEL_MAGIC, {
+            "param": self.param.to_dict(),
+            "n_features": self._n_features,
+            "params": gather_tree(self.params),
+            "opt_m": gather_tree(self._opt["m"]),
+            "opt_s": gather_tree(self._opt["s"]),
+            "opt_t": int(np.asarray(self._opt["t"])),
+        })
+
+    @classmethod
+    def load_model(cls, uri: str, mesh: Optional[Mesh] = None) -> "FM":
+        """Inverse of :meth:`save_model`; predicts immediately and
+        resumes training exactly (Adam moments + step restored)."""
+        from dmlc_core_tpu.models.checkpoint import load_payload
+
+        payload = load_payload(uri, cls._MODEL_MAGIC)
+        model = cls(mesh=mesh, **payload["param"])
+        model._init_state(payload["n_features"])
+        rep = NamedSharding(model.mesh, P())
+        model.params = {k: jax.device_put(v, rep)
+                        for k, v in payload["params"].items()}
+        model._opt = {
+            "m": {k: jax.device_put(v, rep)
+                  for k, v in payload["opt_m"].items()},
+            "s": {k: jax.device_put(v, rep)
+                  for k, v in payload["opt_s"].items()},
+            "t": jnp.asarray(payload["opt_t"], jnp.int32),
+        }
+        return model
+
     # -- inference ------------------------------------------------------
     def predict(self, X: np.ndarray, output_margin: bool = False
                 ) -> np.ndarray:

@@ -142,6 +142,96 @@ class _SquaredError(_ObjectiveBase):
         return float(np.sqrt(m))
 
 
+@OBJECTIVES.register("rank:pairwise")
+class _PairwiseRank(_ObjectiveBase):
+    """RankNet-style pairwise ranking over ``qid`` groups (XGBoost
+    ``rank:pairwise`` — the consumer of the data plane's qid column,
+    reference ``data.h :: Row::qid``, SURVEY.md §2a).
+
+    Contract with :meth:`HistGBT.fit`: rows arrive GROUPED AND PADDED —
+    every query occupies exactly ``group_size`` consecutive rows (pad
+    docs carry ``y = -1`` and weight 0), and shard boundaries fall on
+    group boundaries, so each device's shard is whole groups and the
+    pairwise gradients are shard-local (no cross-device pairs; the
+    histogram psum is the only collective, unchanged).
+
+    Per better-pair (i, j) with rel_i > rel_j inside one group:
+    ``λ = σ(s_j − s_i)``; ``∂L/∂s_i −= λ``, ``∂L/∂s_j += λ``, and both
+    docs accumulate hessian ``λ(1−λ)``.  Groups are processed in
+    ``lax.map`` blocks of ``block_queries`` so the [QB, G, G] pairwise
+    tensors stay a bounded transient instead of O(n·G) at once.
+    """
+
+    is_ranking = True
+
+    def __init__(self, group_size: int, block_queries: int = 256):
+        self.G = int(group_size)
+        self.QB = int(block_queries)
+
+    def _map_blocks(self, pred, y, block_fn):
+        """Shared scaffolding: reshape flat rows into [Q, G] queries, pad
+        the query count to the block multiple (pad queries carry rel −1 →
+        no pairs), and ``lax.map`` over [QB, G] blocks.  ``block_fn``
+        receives the pairwise margin differences ``S[i, j] = s_i − s_j``
+        and the better-pair mask and returns any pytree of per-block
+        results (both the gradients and the loss derive from exactly
+        these two tensors, so padding/sentinel rules live in ONE place).
+        """
+        G = self.G
+        Q = pred.shape[0] // G
+        QB = min(self.QB, Q)
+        qpad = (-Q) % QB
+        s = jnp.pad(pred.reshape(Q, G), ((0, qpad), (0, 0)))
+        r = jnp.pad(y.reshape(Q, G), ((0, qpad), (0, 0)),
+                    constant_values=-1.0)
+
+        def block(args):
+            sb, rb = args                                   # [QB, G]
+            vb = rb >= 0
+            S = sb[:, :, None] - sb[:, None, :]             # s_i − s_j
+            better = ((rb[:, :, None] > rb[:, None, :])
+                      & vb[:, :, None] & vb[:, None, :])
+            return block_fn(S, better)
+
+        nb = (Q + qpad) // QB
+        out = jax.lax.map(block, (s.reshape(nb, QB, G),
+                                  r.reshape(nb, QB, G)))
+        return out, Q
+
+    def grad_hess(self, pred, y):
+        def block_fn(S, better):
+            lam = jnp.where(better, jax.nn.sigmoid(-S), 0.0)
+            rho = lam * (1.0 - lam)
+            g = -lam.sum(axis=2) + lam.sum(axis=1)          # winner/loser
+            h = rho.sum(axis=2) + rho.sum(axis=1)
+            return g, h
+
+        (g, h), Q = self._map_blocks(pred, y, block_fn)
+        G = self.G
+        g = g.reshape(-1, G)[:Q].reshape(Q * G)
+        h = h.reshape(-1, G)[:Q].reshape(Q * G)
+        # docs with no pairs get h=0 → leaf math guards with +lambda, but
+        # keep hessians nonnegative-and-tiny like XGBoost's floor
+        return g, jnp.maximum(h, 1e-16)
+
+    @staticmethod
+    def transform(pred):
+        return pred
+
+    def row_loss(self, pred, y):  # pairwise logloss, averaged per pair
+        log_fatal("rank:pairwise has no per-row loss; use metric()")
+
+    def metric(self, pred, y):
+        """Mean pairwise logistic loss over all better-pairs (same
+        blocked scaffolding as grad_hess — one padding/sentinel rule)."""
+        def block_fn(S, better):
+            return (jnp.where(better, jnp.logaddexp(0.0, -S), 0.0).sum(),
+                    better.sum())
+
+        (losses, counts), _ = self._map_blocks(pred, y, block_fn)
+        return losses.sum() / jnp.maximum(counts.sum(), 1)
+
+
 def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
                      with_child_sums: bool = False,
                      mono: Optional[np.ndarray] = None):
@@ -300,6 +390,10 @@ _METRICS_BY_OBJECTIVE = {
     "binary:logistic": {"logloss", "error", "auc"},
     "reg:squarederror": {"rmse", "mae"},
     "multi:softmax": {"mlogloss", "merror"},
+    # rank eval (ndcg/map) needs qid groups, which EVAL_METRICS'
+    # (margin, y) signature can't see — use models.ranking.ndcg on
+    # predictions instead; in-training eval reports pairwise loss
+    "rank:pairwise": set(),
 }
 
 
@@ -316,7 +410,11 @@ class HistGBTParam(Parameter):
     min_child_weight = field(float, default=1.0, lower_bound=0.0)
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror",
-                            "multi:softmax"])
+                            "multi:softmax", "rank:pairwise"])
+    max_group_size = field(int, default=0, lower_bound=0,
+                           description="rank:pairwise — cap docs per "
+                                       "query (0 = largest group; larger "
+                                       "groups are truncated)")
     num_class = field(int, default=1, lower_bound=1,
                       description="classes for multi:softmax")
     base_score = field(float, default=0.0, description="initial raw margin")
@@ -404,6 +502,7 @@ class HistGBT:
         cuts: Optional[jax.Array] = None,
         eval_set: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         early_stopping_rounds: int = 0,
+        qid: Optional[np.ndarray] = None,
     ) -> "HistGBT":
         """Boost ``n_trees`` rounds.  ``warmup_rounds`` extra rounds are run
         and discarded first (compile + cache warm) so benchmark timing via
@@ -417,10 +516,29 @@ class HistGBT:
         granularity, like XGBoost's per-iteration check rounded up).
         ``best_iteration``/``best_score`` record the winner and
         :meth:`predict` then uses trees up to ``best_iteration+1`` by
-        default."""
+        default.
+
+        ``qid`` (required for ``objective='rank:pairwise'``) groups rows
+        into queries: rows regroup and pad so each query occupies one
+        fixed-size block and shard boundaries fall on query boundaries —
+        pairwise gradients stay shard-local (see :class:`_PairwiseRank`)."""
         p = self.param
         X = np.ascontiguousarray(X, dtype=np.float32)
         y = np.ascontiguousarray(y, dtype=np.float32)
+        self._rank_pos = None
+        if p.objective == "rank:pairwise":
+            CHECK(qid is not None, "rank:pairwise needs qid=")
+            CHECK(eval_set is None,
+                  "rank:pairwise eval_set not supported (metrics need "
+                  "qid groups; use models.ranking.ndcg on predictions)")
+            CHECK(len(self.trees) == 0,
+                  "rank:pairwise continued fit not supported (padded "
+                  "layout is per-fit)")
+            X, y, weight = self._regroup_ranking(X, y, np.asarray(qid),
+                                                 weight)
+        else:
+            CHECK(qid is None, f"qid= only valid for rank:pairwise "
+                  f"(objective is {p.objective!r})")
         n, F = X.shape
         CHECK_EQ(len(y), n, "X/y row mismatch")
         if early_stopping_rounds:
@@ -542,6 +660,59 @@ class HistGBT:
         self._train_preds = preds
         self._n_real_rows = n
         return self
+
+    def _regroup_ranking(self, X, y, qid, weight):
+        """Rearrange rows into fixed-size query blocks for rank:pairwise.
+
+        Stable-sorts by qid, pads every query to ``G`` docs (pad docs:
+        y = −1 sentinel, weight 0, zero features) and pads the query
+        count to a multiple of the mesh size so each shard holds whole
+        queries.  ``max_group_size`` caps G; longer queries TRUNCATE to
+        their first G docs in input order (XGBoost's
+        lambdarank_truncation_level spirit — document counts, don't
+        reorder).  Sets ``self._obj`` to a configured _PairwiseRank and
+        ``self._rank_pos`` (padded position per original row, −1 =
+        truncated away) for :meth:`train_margins`."""
+        p = self.param
+        n = len(y)
+        CHECK_EQ(len(qid), n, "qid/X row mismatch")
+        order = np.argsort(qid, kind="stable")
+        qs = qid[order]
+        starts = np.flatnonzero(np.r_[True, qs[1:] != qs[:-1]])
+        lens = np.diff(np.r_[starts, n])
+        G = int(lens.max())
+        if p.max_group_size:
+            G = min(G, p.max_group_size)
+        ndev = int(np.prod([self.mesh.shape[a]
+                            for a in self.mesh.axis_names]))
+        Q = len(starts)
+        Qp = Q + ((-Q) % ndev)
+        Xp = np.zeros((Qp * G, X.shape[1]), np.float32)
+        yp = np.full(Qp * G, -1.0, np.float32)
+        wp = np.zeros(Qp * G, np.float32)
+        pos = np.full(n, -1, np.int64)
+        w_in = (np.asarray(weight, np.float32) if weight is not None
+                else np.ones(n, np.float32))
+        # one vectorized scatter (a per-query Python loop is O(Q)
+        # interpreter work on the flagship's hot path): rank of each
+        # sorted row within its query = index − its query's start;
+        # rows ranked ≥ G are truncated away
+        within = np.arange(n) - np.repeat(starts, lens)
+        kept = within < G
+        rows_all = order[kept]
+        dst_all = (np.repeat(np.arange(Q, dtype=np.int64), lens)[kept] * G
+                   + within[kept])
+        Xp[dst_all] = X[rows_all]
+        yp[dst_all] = y[rows_all]
+        wp[dst_all] = w_in[rows_all]
+        pos[rows_all] = dst_all
+        truncated = int(n - kept.sum())
+        if truncated:
+            LOG("WARNING", "rank:pairwise: truncated %d docs beyond "
+                "max_group_size=%d", truncated, G)
+        self._obj = _PairwiseRank(G)
+        self._rank_pos = pos
+        return Xp, yp, wp
 
     def _boost_binned(self, bins_t, y_d, w_d, preds, n_features,
                       eval_every=0, warmup_rounds=0, after_chunk=None):
@@ -678,6 +849,9 @@ class HistGBT:
         CHECK(not (p.monotone_constraints
                    and any(int(v) for v in p.monotone_constraints)),
               "fit_external: monotone_constraints not supported — use fit()")
+        CHECK(p.objective != "rank:pairwise",
+              "fit_external: rank:pairwise needs the grouped in-core "
+              "layout — use fit(X, y, qid=...)")
         B = p.n_bins
         depth = p.max_depth
         n_leaf = 1 << depth
@@ -709,12 +883,27 @@ class HistGBT:
         # -- pass 2: bin pages (uint8, FEATURE-major like fit()) -----------
         K_cls = p.num_class
         pages: List[Dict[str, Any]] = []   # "bins" is a jax.Array when cache_device
+        # DMLC_TPU_BIN_BACKEND=cpu bins pages on the host backend and
+        # uploads nothing per page: through a remote-device tunnel, 365
+        # per-page f32 uploads cost seconds each, while the cached path
+        # re-uploads the 4x-smaller uint8 matrix ONCE at concat time.
+        # On a locally attached chip leave it unset (device binning).
+        from dmlc_core_tpu.base.parameter import get_env
+        bin_backend = get_env("DMLC_TPU_BIN_BACKEND", "", str)
+        bin_dev = (jax.local_devices(backend=bin_backend)[0]
+                   if bin_backend else None)
+        cuts_for_bin = np.asarray(self.cuts) if bin_dev is not None else None
         for block in row_iter:
             X = block.to_dense(F)
-            bins = apply_bins(jnp.asarray(X), self.cuts).T   # [F, page_rows]
-            if not cache_device:
-                bins = np.asarray(bins)    # spill to host; one page on
-                                           # device at a time (out-of-core)
+            if bin_dev is not None:
+                with jax.default_device(bin_dev):
+                    bins = np.asarray(apply_bins(
+                        jnp.asarray(X), jnp.asarray(cuts_for_bin)).T)
+            else:
+                bins = apply_bins(jnp.asarray(X), self.cuts).T  # [F, rows]
+                if not cache_device:
+                    bins = np.asarray(bins)  # spill to host; one page on
+                                             # device at a time (out-of-core)
             w = (np.asarray(block.weight, np.float32)
                  if block.weight is not None else np.ones(len(X), np.float32))
             pages.append({
@@ -1201,11 +1390,21 @@ class HistGBT:
 
         Available after :meth:`fit` and ``fit_external(cache_device=
         True)``; the page-loop external path keeps margins per page and
-        clears this state (stale-evidence rule in fit_external)."""
+        clears this state (stale-evidence rule in fit_external).  After
+        a rank:pairwise fit, margins return in the ORIGINAL row order
+        (the padded-group layout is unwound); docs truncated by
+        ``max_group_size`` get NaN."""
         CHECK(getattr(self, "_train_preds", None) is not None,
               "call fit first (train_margins is unavailable after a "
               "cache_device=False external fit)")
-        return np.asarray(self._train_preds)[: self._n_real_rows]
+        flat = np.asarray(self._train_preds)
+        pos = getattr(self, "_rank_pos", None)
+        if pos is not None:
+            out = np.full(len(pos), np.nan, np.float32)
+            kept = pos >= 0
+            out[kept] = flat[pos[kept]]
+            return out
+        return flat[: self._n_real_rows]
 
     def _margin_shape(self, n: int) -> Tuple[int, ...]:
         """Margins are [n] single-output, [n, K] multiclass."""

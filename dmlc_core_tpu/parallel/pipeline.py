@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ
 from dmlc_core_tpu.base.parameter import Parameter, field
+from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.parallel.mesh import local_mesh
 
 __all__ = ["pipeline_apply", "PipelineLM", "PipelineLMParam"]
@@ -280,13 +281,65 @@ class PipelineLM:
             return new_params, loss
 
         batch_spec = P("data")
+        in_specs = ({k: specs[k] for k in specs},
+                    batch_spec, batch_spec, batch_spec)
         mapped = shard_map(
-            step, mesh=self.mesh,
-            in_specs=({k: specs[k] for k in specs},
-                      batch_spec, batch_spec, batch_spec),
+            step, mesh=self.mesh, in_specs=in_specs,
             out_specs=({k: specs[k] for k in specs}, P()),
             check_vma=False)
         self._step_fn = jax.jit(mapped, donate_argnums=(0,))
+
+        # scan-chunked multi-step program (fit_chunked): K steps per
+        # dispatch, same rationale as BERT.fit_chunked — a per-step host
+        # sync through a remote-device tunnel dominates a sub-100ms step
+        self._multi_cache: Dict[int, Any] = {}
+
+        def make_multi(K: int):
+            if K not in self._multi_cache:
+                def multi(params, tokens, labels, mask):
+                    def body(ps, _):
+                        return step(ps, tokens, labels, mask)
+
+                    return lax.scan(body, params, None, length=K)
+
+                mapped_k = shard_map(
+                    multi, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=({k: specs[k] for k in specs}, P()),
+                    check_vma=False)
+                self._multi_cache[K] = jax.jit(mapped_k, donate_argnums=(0,))
+            return self._multi_cache[K]
+
+        self._make_multi = make_multi
+
+    # -- checkpointing (Stream/serializer consumer layer) ---------------
+    _MODEL_MAGIC = b"DMLCTPU.PIPELM.v1\n"
+
+    def save_model(self, uri: str) -> None:
+        """Serialize hyperparams + params to any Stream URI (SURVEY.md
+        §5 checkpoint layering; see models/checkpoint.py).  Pipe-sharded
+        layer slabs gather to full arrays on save and re-shard on load,
+        so the checkpoint is portable across pipe widths."""
+        from dmlc_core_tpu.models.checkpoint import gather_tree, save_payload
+
+        CHECK(self.params is not None, "save_model before init_params")
+        save_payload(uri, self._MODEL_MAGIC, {
+            "param": self.param.to_dict(),
+            "params": gather_tree(self.params),
+        })
+
+    @classmethod
+    def load_model(cls, uri: str,
+                   mesh: Optional[Mesh] = None) -> "PipelineLM":
+        from dmlc_core_tpu.models.checkpoint import load_payload
+
+        payload = load_payload(uri, cls._MODEL_MAGIC)
+        model = cls(mesh=mesh, **payload["param"])
+        specs = model._specs()
+        model.params = {
+            k: jax.device_put(v, NamedSharding(model.mesh, specs[k]))
+            for k, v in payload["params"].items()}
+        model._build_step()
+        return model
 
     # -- public API -----------------------------------------------------
     def train_step(self, tokens: np.ndarray, labels: np.ndarray,
@@ -298,3 +351,38 @@ class PipelineLM:
         m = jax.device_put(np.asarray(mask, np.float32), sh)
         self.params, loss = self._step_fn(self.params, t, y, m)
         return float(loss)
+
+    def fit_chunked(self, tokens: np.ndarray, labels: np.ndarray,
+                    mask: np.ndarray, n_steps: int, chunk: int = 10,
+                    warmup_chunks: int = 1):
+        """Run ``n_steps`` SGD steps as lax.scan chunks of ``chunk`` per
+        dispatch; returns ``(final_loss, seconds, chunk_times)`` with
+        in-order per-chunk loss-arrival timestamps (the bench audit
+        pattern).  Steady-state timing: warmup chunks run first."""
+        CHECK(self.params is not None, "call init_params() first")
+        CHECK(n_steps % chunk == 0,
+              f"n_steps {n_steps} must be a multiple of chunk {chunk}")
+        sh = NamedSharding(self.mesh, P("data"))
+        t = jax.device_put(np.asarray(tokens, np.int32), sh)
+        y = jax.device_put(np.asarray(labels, np.int32), sh)
+        m = jax.device_put(np.asarray(mask, np.float32), sh)
+        fn = self._make_multi(chunk)
+        for _ in range(max(warmup_chunks, 1)):
+            self.params, losses = fn(self.params, t, y, m)
+        np.asarray(losses[-1:])
+        t0 = get_time()
+        loss_chunks = []
+        done = 0
+        while done < n_steps:
+            self.params, losses = fn(self.params, t, y, m)
+            loss_chunks.append(losses)
+            done += chunk
+        chunk_times = []
+        fetched = 0
+        final_loss = float("nan")
+        for losses in loss_chunks:
+            arr = np.asarray(losses)
+            fetched += len(arr)
+            chunk_times.append((fetched, get_time() - t0))
+            final_loss = float(arr[-1])
+        return final_loss, get_time() - t0, chunk_times

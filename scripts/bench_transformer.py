@@ -18,7 +18,6 @@ One JSON line per model.  ``BENCH_T_MODELS=bert,moe,pipeline`` selects.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -119,12 +118,8 @@ def bench_pipeline(devs, steps, chunk):
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, 30522, size=(B, S)).astype(np.int32)
     mask = np.ones((B, S), np.float32)
-    model.train_step(tokens, tokens.copy(), mask)   # compile + warm
-    t0 = time.perf_counter()
-    loss = float("nan")
-    for _ in range(steps):
-        loss = model.train_step(tokens, tokens.copy(), mask)
-    secs = time.perf_counter() - t0
+    loss, secs, chunk_times = model.fit_chunked(
+        tokens, tokens.copy(), mask, n_steps=steps, chunk=chunk)
     return {
         "model": "pipeline_lm", "layers": 12, "d_model": 512, "seq": S,
         "batch": B, "n_micro": 8,
@@ -132,8 +127,7 @@ def bench_pipeline(devs, steps, chunk):
         "steps_per_sec": round(steps / secs, 3),
         "tokens_per_sec": round(B * S * steps / secs),
         "final_loss": round(float(loss), 4),
-        "note": "per-step host sync incl. tunnel latency (no chunked "
-                "path for the pipeline trainer yet)",
+        "chunk_times": [(d, round(t, 3)) for d, t in chunk_times],
         **_mem_stats(devs[0]),
     }
 

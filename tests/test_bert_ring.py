@@ -113,6 +113,26 @@ class TestBERT:
         assert secs > 0
         assert chunk_times[-1][0] == 4      # all steps accounted for
 
+    def test_save_load_roundtrip(self, tmp_path):
+        """Checkpoint (Stream/serializer layer) must restore params AND
+        momentum so a resumed model continues the exact trajectory."""
+        tokens, labels, mask = _batch(seed=11)
+        mesh = create_mesh(MeshSpec(data=2, model=2, seq=2))
+        m = BERT(mesh=mesh, **TINY)
+        m.init_params(5)
+        m.train_step(tokens, labels, mask)     # non-zero momentum
+        uri = str(tmp_path / "bert.ckpt")
+        m.save_model(uri)
+        m2 = BERT.load_model(uri, mesh=mesh)
+        l_orig = m.train_step(tokens, labels, mask)
+        l_load = m2.train_step(tokens, labels, mask)
+        np.testing.assert_allclose(l_load, l_orig, rtol=1e-6)
+        # wrong-magic file fails loudly
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.parallel.pipeline import PipelineLM
+        with pytest.raises(Error, match="magic"):
+            PipelineLM.load_model(uri)
+
     def test_kvstore_first_step_matches_fused(self):
         mesh = create_mesh(MeshSpec(data=4, seq=2))
         tokens, labels, mask = _batch(seed=2)

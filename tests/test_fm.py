@@ -67,6 +67,22 @@ class TestFMTraining:
         acc_lin = float(((lin.predict(X) > 0.5) == (y > 0.5)).mean())
         assert acc_lin < 0.6, acc_lin          # interactions were the signal
 
+    def test_save_load_roundtrip(self, rng, tmp_path):
+        """Checkpoint restores weights AND Adam state: the reloaded
+        model predicts identically and continues training the exact
+        trajectory (step count preserved, no bias-correction reset)."""
+        X = rng.normal(size=(512, 6)).astype(np.float32)
+        y = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+        m = FM(n_factors=4, n_epochs=2, seed=0)
+        m.fit(X, y)
+        uri = str(tmp_path / "fm.ckpt")
+        m.save_model(uri)
+        m2 = FM.load_model(uri)
+        np.testing.assert_allclose(m2.predict(X), m.predict(X), rtol=1e-6)
+        m.fit(X, y)        # continue both one more round
+        m2.fit(X, y)
+        np.testing.assert_allclose(m2.predict(X), m.predict(X), rtol=1e-5)
+
     def test_regression_objective(self, rng):
         X, _, margin = _interaction_data(rng, n=3000)
         m = FM(objective="reg:squarederror", n_factors=8, n_epochs=40,

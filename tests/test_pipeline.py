@@ -105,3 +105,46 @@ class TestPipelineLM:
         m.init_params(1)
         losses = [m.train_step(tokens, labels, mask) for _ in range(8)]
         assert losses[-1] < losses[0] - 0.1, losses
+
+    def test_save_load_roundtrip_across_pipe_widths(self, rng, tmp_path):
+        """A checkpoint written from a pipelined mesh must load onto a
+        plain data mesh (pipe-sharded slabs gather on save) and keep the
+        exact loss trajectory."""
+        tokens, labels, mask = self._data(rng)
+        m = PipelineLM(mesh=_mesh(2, 4), **self.KW)
+        m.init_params(2)
+        m.train_step(tokens, labels, mask)
+        uri = str(tmp_path / "plm.ckpt")
+        m.save_model(uri)
+        m2 = PipelineLM.load_model(
+            uri, mesh=Mesh(np.asarray(jax.devices()[:2]).reshape(2),
+                           ("data",)))
+        l_orig = m.train_step(tokens, labels, mask)
+        l_load = m2.train_step(tokens, labels, mask)
+        np.testing.assert_allclose(l_load, l_orig, rtol=1e-4)
+
+    def test_fit_chunked_matches_per_step(self, rng):
+        """The scan-chunked program (tunnel bench path) must reproduce
+        the per-step trajectory exactly on the pipelined mesh."""
+        tokens, labels, mask = self._data(rng)
+        mesh = _mesh(2, 2)
+        m1 = PipelineLM(mesh=mesh, **self.KW)
+        m1.init_params(4)
+        per_step = [m1.train_step(tokens, labels, mask) for _ in range(4)]
+        m2 = PipelineLM(mesh=mesh, **self.KW)
+        m2.init_params(4)
+        fn = m2._make_multi(4)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P("data"))
+        t = jax.device_put(np.asarray(tokens, np.int32), sh)
+        y = jax.device_put(np.asarray(labels, np.int32), sh)
+        mk = jax.device_put(np.asarray(mask, np.float32), sh)
+        _, losses = fn(m2.params, t, y, mk)
+        np.testing.assert_allclose(np.asarray(losses), per_step, rtol=1e-5)
+        # public wrapper: bookkeeping + finiteness
+        m3 = PipelineLM(mesh=mesh, **self.KW)
+        m3.init_params(4)
+        loss, secs, chunk_times = m3.fit_chunked(
+            tokens, labels, mask, n_steps=4, chunk=2)
+        assert np.isfinite(loss) and secs > 0
+        assert chunk_times[-1][0] == 4

@@ -1,0 +1,132 @@
+"""rank:pairwise objective + ranking metrics.
+
+Oracles: a synthetic learning-to-rank problem with a known scoring
+function (pairwise accuracy and ndcg must rise well above chance);
+numpy metric cross-checks; 8-device-mesh vs 1-device equivalence (the
+shard-local-pairs design claim — groups never straddle shards, so the
+mesh trajectory must match single-device bit-for-bit up to f32 psum
+rounding); padding/truncation bookkeeping."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from dmlc_core_tpu.models import HistGBT
+from dmlc_core_tpu.models.ranking import (mean_average_precision, ndcg,
+                                          pairwise_accuracy)
+from dmlc_core_tpu.parallel.mesh import local_mesh
+
+
+def _ltr_problem(n_queries=64, docs_lo=5, docs_hi=12, F=6, seed=0):
+    """Docs with features; relevance = rank of a hidden linear score."""
+    rng = np.random.default_rng(seed)
+    Xs, ys, qids = [], [], []
+    wtrue = rng.normal(size=F)
+    for q in range(n_queries):
+        nd = int(rng.integers(docs_lo, docs_hi + 1))
+        X = rng.normal(size=(nd, F)).astype(np.float32)
+        s = X @ wtrue
+        rel = np.zeros(nd, np.float32)
+        rel[np.argsort(s)[-2:]] = 1.0        # top-2 docs are relevant
+        rel[np.argsort(s)[-1]] = 2.0         # best doc doubly so
+        Xs.append(X)
+        ys.append(rel)
+        qids.append(np.full(nd, q, np.int64))
+    return (np.concatenate(Xs), np.concatenate(ys),
+            np.concatenate(qids))
+
+
+class TestRankingMetrics:
+    def test_ndcg_perfect_and_inverted(self):
+        y = np.array([2.0, 1.0, 0.0, 0.0])
+        qid = np.zeros(4, np.int64)
+        assert ndcg(y, np.array([4.0, 3.0, 2.0, 1.0]), qid) == 1.0
+        inv = ndcg(y, np.array([1.0, 2.0, 3.0, 4.0]), qid)
+        assert 0.0 < inv < 0.7
+        # all-zero relevance query scores 1.0 (unjudgeable)
+        assert ndcg(np.zeros(3), np.arange(3.0), np.zeros(3, np.int64)) == 1.0
+
+    def test_map_and_pairwise_accuracy(self):
+        y = np.array([1.0, 0.0, 1.0, 0.0])
+        qid = np.array([0, 0, 1, 1], np.int64)
+        assert mean_average_precision(y, np.array([2., 1., 1., 2.]), qid) == 0.75
+        assert pairwise_accuracy(y, np.array([2., 1., 1., 2.]), qid) == 0.5
+
+    def test_ndcg_at_k_truncates(self):
+        y = np.array([0.0, 0.0, 2.0])
+        qid = np.zeros(3, np.int64)
+        # relevant doc ranked last: ndcg@2 sees only irrelevant docs
+        sc = np.array([3.0, 2.0, 1.0])
+        assert ndcg(y, sc, qid, k=2) == 0.0
+        assert ndcg(y, sc, qid) > 0.0
+
+
+class TestPairwiseRankObjective:
+    def test_learns_to_rank(self):
+        X, y, qid = _ltr_problem()
+        m = HistGBT(n_trees=40, max_depth=3, n_bins=32,
+                    objective="rank:pairwise", learning_rate=0.3)
+        m.fit(X, y, qid=qid)
+        scores = m.predict(X)
+        acc = pairwise_accuracy(y, scores, qid)
+        nd = ndcg(y, scores, qid, k=5)
+        assert acc > 0.85, acc               # chance = 0.5
+        assert nd > 0.85, nd
+
+    def test_mesh_matches_single_device(self):
+        """Groups never straddle shards, so pairwise grads are
+        shard-local and the 8-way mesh must reproduce the 1-device
+        model.  This is the mesh-parity oracle for the in-loss-psum
+        gradient bug class (a broken gradient diverges in round 1 by
+        O(1), verified during development; the residual mesh-vs-single
+        difference is f32 psum summation-order rounding ~1e-7 in leaf
+        values, which can flip a near-tie split only after gradients
+        shrink — same property as the reference's rabit allreduce — so
+        exact tree equality is asserted over the early rounds and
+        margin agreement at f32 tolerance)."""
+        X, y, qid = _ltr_problem(n_queries=48, seed=3)
+        kw = dict(n_trees=4, max_depth=3, n_bins=32,
+                  objective="rank:pairwise")
+        m8 = HistGBT(mesh=local_mesh(), **kw)       # conftest: 8 devices
+        m8.fit(X, y, qid=qid)
+        m1 = HistGBT(mesh=Mesh(np.asarray(jax.devices()[:1]), ("data",)),
+                     **kw)
+        m1.fit(X, y, qid=qid)
+        # round 1 sees bit-identical gradients → identical tree
+        t8, t1 = m8.trees[0], m1.trees[0]
+        np.testing.assert_array_equal(t8["feat"], t1["feat"])
+        np.testing.assert_array_equal(t8["thr"], t1["thr"])
+        np.testing.assert_allclose(t8["leaf"], t1["leaf"],
+                                   rtol=1e-5, atol=1e-6)
+        # a shard-count gradient bug would diverge margins O(1) here;
+        # legitimate psum rounding stays at f32 epsilon scale
+        np.testing.assert_allclose(m8.train_margins(), m1.train_margins(),
+                                   atol=1e-4)
+
+    def test_train_margins_unwind_and_truncation(self):
+        X, y, qid = _ltr_problem(n_queries=16, docs_lo=3, docs_hi=9,
+                                 seed=5)
+        m = HistGBT(n_trees=5, max_depth=2, n_bins=16,
+                    objective="rank:pairwise", max_group_size=6)
+        m.fit(X, y, qid=qid)
+        tm = m.train_margins()
+        assert tm.shape == y.shape
+        kept = ~np.isnan(tm)
+        # truncated docs (beyond 6 per query) are NaN; kept ones match
+        # predict() on the same rows
+        pred = m.predict(X, output_margin=True)
+        np.testing.assert_allclose(tm[kept], pred[kept], rtol=1e-4,
+                                   atol=1e-5)
+        lens = np.bincount(qid.astype(int))
+        assert (~kept).sum() == np.maximum(lens - 6, 0).sum()
+
+    def test_qid_validation(self):
+        X = np.zeros((4, 2), np.float32)
+        y = np.zeros(4, np.float32)
+        from dmlc_core_tpu.base.logging import Error
+        with pytest.raises(Error, match="needs qid"):
+            HistGBT(objective="rank:pairwise").fit(X, y)
+        with pytest.raises(Error, match="only valid for rank"):
+            HistGBT().fit(X, y, qid=np.zeros(4, np.int64))
