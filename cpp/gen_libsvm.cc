@@ -1,0 +1,80 @@
+// Criteo-shaped LibSVM generator for the external-memory benchmark.
+//
+// Writes ROWS rows of "label idx:val ..." with F sparse features (~3%
+// missing per row, fixed-point values) at disk speed — formatting ~2e9
+// fields in Python on this 1-core host would take the better part of an
+// hour; this does it in minutes.  Deterministic per (seed, row), so a
+// given (rows, features, seed) triple always produces the same file.
+//
+//   g++ -O2 -o ../build/gen_libsvm gen_libsvm.cc
+//   ./build/gen_libsvm <rows> <features> <out_path> [seed]
+//
+// Reference context: the Criteo configs in BASELINE.md config 3; format
+// per src/data/libsvm_parser.h (label idx:val with 0-based indices).
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s <rows> <features> <out> [seed]\n",
+                 argv[0]);
+    return 2;
+  }
+  const int64_t rows = std::strtoll(argv[1], nullptr, 10);
+  const int features = std::atoi(argv[2]);
+  const char* path = argv[3];
+  const uint64_t seed = argc > 4 ? std::strtoull(argv[4], nullptr, 10) : 7;
+
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) { std::perror("fopen"); return 1; }
+  // ~4MB stdio buffer keeps fwrite syscalls rare
+  static char iobuf[4 << 20];
+  std::setvbuf(f, iobuf, _IOFBF, sizeof(iobuf));
+
+  // per-feature worst case ≈ 16 bytes (" 99999:-8.00"); size from the
+  // actual feature count so large F cannot overflow the row buffers
+  const size_t cap = 32 + (size_t)features * 24;
+  char* feats = (char*)std::malloc(cap);
+  char* line = (char*)std::malloc(cap);
+  if (!feats || !line) { std::perror("malloc"); return 1; }
+  for (int64_t r = 0; r < rows; ++r) {
+    uint64_t s = splitmix64(seed * 0x100000001b3ULL + (uint64_t)r);
+    char* p = feats;
+    long v0 = 0, v1 = 0, v2 = 0;           // fixed-point feature draws
+    for (int j = 0; j < features; ++j) {
+      s = splitmix64(s);
+      if ((s & 31) == 0) continue;           // ~3% missing
+      // fixed-point value in [-8.00, 8.00), two decimals
+      int v = (int)(s >> 40 & 0x7ff) - 1024; // [-1024, 1023]
+      if (j == 0) v0 = v; else if (j == 1) v1 = v; else if (j == 2) v2 = v;
+      int whole = v / 128, frac = ((v < 0 ? -v : v) % 128) * 100 / 128;
+      p += std::sprintf(p, " %d:%s%d.%02d", j,
+                        (v < 0 && whole == 0) ? "-" : "", whole, frac);
+    }
+    // label: nonlinear rule over the first feature values so the data
+    // is learnable, not pure noise (XGBoost-style smoke semantics)
+    int label = (v0 * v1 + 256 * v2 > 0) ? 1 : 0;
+    char* q = line;
+    *q++ = '0' + label;
+    std::memcpy(q, feats, (size_t)(p - feats));
+    q += p - feats;
+    *q++ = '\n';
+    std::fwrite(line, 1, (size_t)(q - line), f);
+    if ((r & 0xfffff) == 0xfffff)
+      std::fprintf(stderr, "\r%" PRId64 "M rows", (r + 1) >> 20);
+  }
+  std::fprintf(stderr, "\ndone\n");
+  std::fclose(f);
+  std::free(feats);
+  std::free(line);
+  return 0;
+}

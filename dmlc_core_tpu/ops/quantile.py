@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dmlc_core_tpu.base.logging import CHECK
+from dmlc_core_tpu.base.parameter import get_env
 
 __all__ = ["local_summary", "merge_summaries", "compute_cuts", "apply_bins",
            "SketchAccumulator"]
@@ -165,6 +166,14 @@ class SketchAccumulator:
         # merge ladder: _levels[ℓ] = list of ([F, S] summary, weight)
         self._levels: list = [[]]
         self.pages_seen = 0
+        # Per-page summaries are jax ops.  On a locally attached
+        # accelerator that's the right home; through a remote-device
+        # tunnel every page pays an upload+dispatch round trip (measured
+        # ~20 s/page at Criteo shape — 2 h for a 50M-row pass), so the
+        # sketch can be pinned to the host CPU backend instead.
+        backend = get_env("DMLC_TPU_SKETCH_BACKEND", "", str)
+        self._device = (jax.local_devices(backend=backend)[0]
+                        if backend else None)
 
     def add(self, x: np.ndarray, weight: Optional[np.ndarray] = None) -> None:
         """Absorb a page of rows ``[n, F]`` (``weight``: [n] or None)."""
@@ -172,12 +181,15 @@ class SketchAccumulator:
         CHECK(x.shape[1] == self._F, "feature-count mismatch")
         if x.shape[0] == 0:
             return
-        s = local_summary(jnp.asarray(x),
-                          None if weight is None else jnp.asarray(weight),
-                          self._S)
+        with self._on_device():
+            s = local_summary(
+                jnp.asarray(x),
+                None if weight is None else jnp.asarray(weight),
+                self._S)
+            s = np.asarray(s)
         wt = float(x.shape[0] if weight is None else np.sum(weight))
         self.pages_seen += 1
-        self._levels[0].append((np.asarray(s), wt))
+        self._levels[0].append((s, wt))
         lvl = 0
         while len(self._levels[lvl]) >= self._cap:   # carry up the ladder
             merged = self._merge_group(self._levels[lvl])
@@ -187,11 +199,19 @@ class SketchAccumulator:
             self._levels[lvl + 1].append(merged)
             lvl += 1
 
+    def _on_device(self):
+        import contextlib
+
+        return (jax.default_device(self._device) if self._device is not None
+                else contextlib.nullcontext())
+
     def _merge_group(self, group: list) -> tuple:
-        stack = jnp.asarray(np.stack([s for s, _ in group]))
-        wts = np.asarray([w for _, w in group], np.float32)
-        merged = _weighted_collapse(stack, jnp.asarray(wts), self._S)
-        return np.asarray(merged), float(wts.sum())
+        with self._on_device():
+            stack = jnp.asarray(np.stack([s for s, _ in group]))
+            wts = np.asarray([w for _, w in group], np.float32)
+            merged = np.asarray(
+                _weighted_collapse(stack, jnp.asarray(wts), self._S))
+        return merged, float(wts.sum())
 
     def summary(self) -> tuple:
         """Current ``([F, S] summary, total_weight)`` — the fixed-size

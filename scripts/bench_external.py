@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Config-3 at genuinely out-of-core scale (VERDICT r2 #6).
+
+End-to-end: a Criteo-shaped synthetic LibSVM file (default 50M rows x 39
+sparse features, ~16GB text, written by the native cpp/gen_libsvm
+generator) streams through the REAL external-memory stack — LibSVM
+parser -> DiskRowIter binary page cache (#cachefile URI) -> fit_external
+sketch + bin passes -> boosting on the chip — with host RSS tracked the
+whole way.  Reports:
+
+- parse+cache-build seconds, MB/s, pages/s (pass 1 over the text)
+- cached page-replay pages/s (what every later pass pays)
+- fit_external(cache_device=True) rounds/s — binned pages resident in
+  HBM, the in-core chunked engine over paged data
+- fit_external(cache_device=False) page-loop rounds/s on a FEW rounds
+  (the truly device-memory-bounded mode; through a remote tunnel its
+  O(pages x depth) dispatches per round are latency-dominated, which is
+  exactly why cache_device exists — recorded, not hidden)
+- peak host RSS (ru_maxrss), proving the 16GB dataset never
+  materializes on the host
+
+Usage (50M default needs ~40GB free disk for text + page cache):
+    BENCH_EXT_ROWS=50000000 python scripts/bench_external.py
+"""
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("BENCH_EXT_ROWS", 50_000_000))
+FEATS = int(os.environ.get("BENCH_EXT_FEATURES", 39))
+ROUNDS = int(os.environ.get("BENCH_EXT_ROUNDS", 50))
+PAGELOOP_ROUNDS = int(os.environ.get("BENCH_EXT_PAGELOOP_ROUNDS", 2))
+DEPTH = int(os.environ.get("BENCH_EXT_DEPTH", 6))
+BINS = int(os.environ.get("BENCH_EXT_BINS", 256))
+WORKDIR = os.environ.get("BENCH_EXT_DIR", "/tmp/dmlc_ext_bench")
+
+
+def rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def main() -> None:
+    # Through the axon tunnel, per-page device dispatches cost seconds
+    # each (sketch: measured ~20 s/page); pin the streaming passes to
+    # the host CPU backend — the binned matrix still lands on the TPU
+    # once, at cached-concat time.  On a locally attached chip these
+    # knobs should stay unset.
+    os.environ.setdefault("DMLC_TPU_SKETCH_BACKEND", "cpu")
+    os.environ.setdefault("DMLC_TPU_BIN_BACKEND", "cpu")
+    os.makedirs(WORKDIR, exist_ok=True)
+    svm = os.path.join(WORKDIR, f"criteo_{ROWS}x{FEATS}.svm")
+    cache = os.path.join(WORKDIR, f"criteo_{ROWS}x{FEATS}.cache")
+    gen = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "build", "gen_libsvm")
+
+    out = {"rows": ROWS, "features": FEATS, "depth": DEPTH, "bins": BINS}
+
+    if not os.path.exists(svm):
+        t0 = time.perf_counter()
+        subprocess.run([gen, str(ROWS), str(FEATS), svm, "7"], check=True,
+                       stderr=subprocess.DEVNULL)
+        out["gen_seconds"] = round(time.perf_counter() - t0, 1)
+    out["text_gb"] = round(os.path.getsize(svm) / 1e9, 2)
+
+    from dmlc_core_tpu.data.iter import RowBlockIter
+
+    # pass 1: parse text -> binary page cache (DiskRowIter ctor)
+    for f in (cache, cache + ".part0"):
+        if os.path.exists(f):
+            os.remove(f)
+    t0 = time.perf_counter()
+    it = RowBlockIter.create(f"{svm}#{cache}", 0, 1, "libsvm")
+    out["parse_cache_seconds"] = round(time.perf_counter() - t0, 1)
+    out["parse_mb_per_sec"] = round(
+        os.path.getsize(svm) / 1e6 / out["parse_cache_seconds"], 1)
+    out["cache_gb"] = round(os.path.getsize(cache) / 1e9, 2)
+    out["pages"] = it._num_pages
+    out["rss_after_parse_gb"] = round(rss_gb(), 2)
+
+    # cached page replay rate (what the sketch/bin passes and every
+    # page-loop level pay to read a page back)
+    t0 = time.perf_counter()
+    n_pages = n_rows = 0
+    for block in it:
+        n_pages += 1
+        n_rows += block.size
+    dt = time.perf_counter() - t0
+    assert n_rows == ROWS, (n_rows, ROWS)
+    out["replay_pages_per_sec"] = round(n_pages / dt, 2)
+    out["replay_rows_per_sec"] = round(n_rows / dt)
+
+    from dmlc_core_tpu.models import HistGBT
+
+    # headline: device-cached external training (binned pages in HBM)
+    m = HistGBT(n_trees=ROUNDS, max_depth=DEPTH, n_bins=BINS)
+    t0 = time.perf_counter()
+    m.fit_external(it, num_col=FEATS, cache_device=True, warmup_rounds=5)
+    out["cache_device_total_seconds"] = round(time.perf_counter() - t0, 1)
+    out["cache_device_boost_seconds"] = round(m.last_fit_seconds, 2)
+    out["cache_device_rounds_per_sec"] = round(
+        ROUNDS / m.last_fit_seconds, 3)
+    out["chunk_seconds_per_round"] = [
+        round((t2 - t1) / (d2 - d1), 4)
+        for (d1, t1), (d2, t2) in zip([(0, 0.0)] + m.last_chunk_times,
+                                      m.last_chunk_times)]
+    out["rss_after_cached_fit_gb"] = round(rss_gb(), 2)
+
+    # true out-of-core page loop, a few rounds (device memory bounded by
+    # one page; per-level host dispatches pay tunnel latency — recorded)
+    if PAGELOOP_ROUNDS > 0:
+        m2 = HistGBT(n_trees=PAGELOOP_ROUNDS, max_depth=DEPTH, n_bins=BINS)
+        t0 = time.perf_counter()
+        m2.fit_external(it, num_col=FEATS, cuts=m.cuts, cache_device=False)
+        dt = time.perf_counter() - t0
+        out["pageloop_rounds"] = PAGELOOP_ROUNDS
+        out["pageloop_rounds_per_sec"] = round(
+            PAGELOOP_ROUNDS / m2.last_fit_seconds, 4)
+        out["pageloop_total_seconds"] = round(dt, 1)
+    it.close()
+    out["peak_rss_gb"] = round(rss_gb(), 2)
+    try:
+        import jax
+        out["platform"] = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        out["platform"] = "unknown"
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
