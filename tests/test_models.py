@@ -49,6 +49,33 @@ class TestHistogram:
         ref = reference_histogram(bins, node, g, h, N, B)
         np.testing.assert_allclose(out, ref, atol=2e-2, rtol=1e-2)  # bf16 dot
 
+    def test_lo_factor_table_and_model(self):
+        # n_bins=256 answers come from the v5e sweep table; other bin
+        # counts from the 5A+2lo op model. The MXU work A*lo is invariant
+        # in lo, so any answer must keep lo*ceil(B/lo) >= B (coverage).
+        from dmlc_core_tpu.ops.histogram import _LO_MEASURED_256, _lo_factor
+
+        for n_build, want in _LO_MEASURED_256.items():
+            assert _lo_factor(n_build, 256) == want
+        for n_nodes in (1, 2, 4, 32, 64):
+            for n_bins in (64, 128, 512):
+                lo = _lo_factor(n_nodes, n_bins)
+                assert lo <= max(n_bins, 8)
+                assert lo * (-(-n_bins // lo)) >= n_bins
+
+    def test_pallas_ok_vmem_guard(self):
+        # calibrated VMEM-stack guard: the default tile passes at every
+        # default level; tile 65536 (measured 16MB scoped-vmem OOM on
+        # v5e at 10M rows) must be rejected so build_histogram falls
+        # back to matmul instead of failing compilation
+        from dmlc_core_tpu.ops.histogram import _TILE_ROWS, _pallas_ok
+
+        for n_build in (1, 2, 4, 8, 16):
+            assert _pallas_ok(256, 28, n_build, 1, _TILE_ROWS)
+        assert not _pallas_ok(256, 28, 1, 1, 65536)
+        # int32 bins (>256 bin counts) scale the tile budget too
+        assert _pallas_ok(512, 28, 1, 4, _TILE_ROWS)
+
     def test_pallas_subtile_packing(self, rng, monkeypatch):
         # S>1 subtile packing (ops/histogram.py _pack_factor) is disabled
         # on v5e (measured slower) but the plumbing is a documented seam
