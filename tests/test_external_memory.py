@@ -301,6 +301,44 @@ def test_external_memory_multiclass(tmp_path):
                                    rtol=1e-3, atol=1e-4)
 
 
+def test_host_pinned_passes_match_default(tmp_path, monkeypatch):
+    """DMLC_TPU_SKETCH_BACKEND / DMLC_TPU_BIN_BACKEND pin the streaming
+    passes to the host backend (the remote-tunnel mode bench_external
+    uses).  Same cuts, same trees as the default path."""
+    from dmlc_core_tpu.data.iter import RowBlockIter
+    from dmlc_core_tpu.models import HistGBT
+
+    X, y = _synth(1500, 5)
+    svm = tmp_path / "p.svm"
+    _write_libsvm(svm, X, y)
+
+    # conftest pins jax to CPU devices, so both branches compute on the
+    # same backend and exact tree equality is deterministic (this test
+    # checks the PINNING CODE PATH, not cross-backend float parity)
+    models = {}
+    for pinned in (False, True):
+        if pinned:
+            monkeypatch.setenv("DMLC_TPU_SKETCH_BACKEND", "cpu")
+            monkeypatch.setenv("DMLC_TPU_BIN_BACKEND", "cpu")
+        else:
+            # ambient env (e.g. a bench_external debug session) must not
+            # turn this into a vacuous pinned-vs-pinned comparison
+            monkeypatch.delenv("DMLC_TPU_SKETCH_BACKEND", raising=False)
+            monkeypatch.delenv("DMLC_TPU_BIN_BACKEND", raising=False)
+        m = HistGBT(n_trees=4, max_depth=3, n_bins=32)
+        it = RowBlockIter.create(str(svm), 0, 1, "libsvm")
+        m.fit_external(it, num_col=5)
+        it.close()
+        models[pinned] = m
+    np.testing.assert_allclose(np.asarray(models[True].cuts),
+                               np.asarray(models[False].cuts),
+                               rtol=1e-6)
+    for t0, t1 in zip(models[False].trees, models[True].trees):
+        np.testing.assert_array_equal(t0["feat"], t1["feat"])
+        np.testing.assert_array_equal(t0["thr"], t1["thr"])
+        np.testing.assert_allclose(t0["leaf"], t1["leaf"], rtol=1e-4)
+
+
 def test_cache_device_matches_default(tmp_path):
     from dmlc_core_tpu.data.iter import RowBlockIter
     from dmlc_core_tpu.models import HistGBT
