@@ -11,9 +11,14 @@ and carrying the benchmarks:
 * :mod:`bert` — transformer encoder trained with KVStore-shaped gradient
   sync (BASELINE config 4); dense or Switch-MoE FFN over the expert axis.
 * :mod:`fm` — factorization machines, the LibFM-format consumer.
+* :mod:`linear` — GBLinear, the linear booster (XGBoost
+  ``booster=gblinear``), parallel damped coordinate updates on the MXU.
+* :mod:`ranking` — ndcg/map/pairwise-accuracy metrics over qid groups
+  (companions to HistGBT's ``rank:pairwise`` objective).
 """
 
 from dmlc_core_tpu.models.histgbt import HistGBT, HistGBTParam  # noqa: F401
 from dmlc_core_tpu.models.resnet import ResNet, ResNetParam, ResNetTrainer  # noqa: F401
 from dmlc_core_tpu.models.bert import BERT, BERTParam  # noqa: F401
 from dmlc_core_tpu.models.fm import FM, FMParam  # noqa: F401
+from dmlc_core_tpu.models.linear import GBLinear, GBLinearParam  # noqa: F401
