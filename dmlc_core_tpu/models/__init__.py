@@ -22,3 +22,15 @@ from dmlc_core_tpu.models.resnet import ResNet, ResNetParam, ResNetTrainer  # no
 from dmlc_core_tpu.models.bert import BERT, BERTParam  # noqa: F401
 from dmlc_core_tpu.models.fm import FM, FMParam  # noqa: F401
 from dmlc_core_tpu.models.linear import GBLinear, GBLinearParam  # noqa: F401
+
+_SKLEARN_WRAPPERS = ("GBTClassifier", "GBTRegressor", "GBTRanker")
+
+
+def __getattr__(name):
+    # lazy: models.sklearn imports the real scikit-learn (≈1 s + scipy)
+    # — flagship paths that never touch the wrappers must not pay it
+    if name in _SKLEARN_WRAPPERS:
+        from dmlc_core_tpu.models import sklearn as _sk
+
+        return getattr(_sk, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1371,6 +1371,43 @@ class HistGBT:
                 margin if output_margin else self._obj.transform(margin)))
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
+    def predict_leaf(self, X: np.ndarray,
+                     n_trees: Optional[int] = None) -> np.ndarray:
+        """Per-tree leaf assignment — XGBoost's ``pred_leaf=True``.
+
+        Returns int32 ``[n, T]`` (multiclass: ``[n, T, K]``) of leaf
+        positions in ``[0, 2^max_depth)`` — the index within each
+        depth-complete tree's leaf layer (XGBoost's global node ids for
+        a complete tree are ``leaf + 2^depth − 1``).  The classic use is
+        GBDT feature embeddings (leaf one-hots into a linear model)."""
+        CHECK(self.cuts is not None, "predict before fit")
+        CHECK(len(self.trees) > 0, "no trees trained")
+        depth = self.param.max_depth
+        if n_trees is None and getattr(self, "_early_stopped", False) \
+                and self.best_iteration is not None:
+            n_trees = self.best_iteration + 1
+        use = self.trees if n_trees is None else self.trees[:n_trees]
+        stacked = self._stacked_trees(use)
+        X = np.ascontiguousarray(X, dtype=np.float32)
+        if len(X) == 0:
+            shape = ((0, len(use), self.param.num_class)
+                     if self.param.num_class > 1 else (0, len(use)))
+            return np.zeros(shape, np.int32)
+        outs = []
+        for lo in range(0, len(X), self._PREDICT_BATCH):
+            bins = apply_bins(jnp.asarray(X[lo:lo + self._PREDICT_BATCH]),
+                              self.cuts)
+            if stacked["feat"].ndim == 4:   # multiclass [T, K, depth, half]
+                cols = [_leaf_indices(bins, stacked["feat"][:, c],
+                                      stacked["thr"][:, c], depth)
+                        for c in range(stacked["feat"].shape[1])]
+                outs.append(np.stack([np.asarray(c) for c in cols], axis=2))
+            else:
+                outs.append(np.asarray(
+                    _leaf_indices(bins, stacked["feat"], stacked["thr"],
+                                  depth)))
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
     def predict_proba(self, X: np.ndarray,
                       n_trees: Optional[int] = None) -> np.ndarray:
         """Class probability matrix [n, K] (``multi:softprob`` semantics);
@@ -1556,3 +1593,23 @@ def _predict_trees(bins, feats, thrs, leaves, depth: int,
         init = jnp.full(bins.shape[0], base_score, jnp.float32)
     total, _ = jax.lax.scan(one_tree, init, (feats, thrs, leaves))
     return total
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _leaf_indices(bins, feats, thrs, depth: int):
+    """Per-tree leaf assignment [n, T] (predict_leaf); same unrolled
+    descent as _predict_trees, collecting the final node instead of
+    summing leaf values."""
+
+    def one_tree(_, tree):
+        feat, thr = tree
+        node = jnp.zeros(bins.shape[0], jnp.int32)
+        for _level in range(depth):
+            f = feat[_level][node]
+            t = thr[_level][node]
+            row_bin = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+            node = 2 * node + (row_bin > t).astype(jnp.int32)
+        return 0, node
+
+    _, nodes = jax.lax.scan(one_tree, 0, (feats, thrs))   # [T, n]
+    return nodes.T

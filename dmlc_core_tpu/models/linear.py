@@ -58,7 +58,9 @@ class GBLinearParam(Parameter):
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror"])
     base_score = field(float, default=0.0)
-    seed = field(int, default=0)
+    # no seed field: the parallel coordinate rounds are deterministic
+    # (no subsampling) — an accepted-but-inert reproducibility knob
+    # would mislead
 
 
 class GBLinear:

@@ -1,0 +1,209 @@
+"""scikit-learn-style estimator wrappers (XGBClassifier-family analog).
+
+XGBoost users reach its boosters through the sklearn API at least as
+often as through the native one; these wrappers give HistGBT and
+GBLinear the same ergonomic surface — ``fit(X, y)`` / ``predict`` /
+``predict_proba`` / ``score`` / ``get_params`` / ``set_params`` — so
+pipeline code written against ``XGBClassifier``/``XGBRegressor``/
+``XGBRanker`` ports by changing the import.  ``booster='gbtree'``
+selects hist-GBT, ``'gblinear'`` the linear booster, matching
+XGBoost's knob.
+
+No sklearn import is required (duck-typed estimator contract), but the
+wrappers satisfy ``sklearn.base.BaseEstimator`` conventions (params in
+``__init__`` signature order, ``get_params``/``set_params`` round-trip)
+so they compose with sklearn Pipelines and model-selection utilities
+when sklearn is present.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dmlc_core_tpu.base.logging import CHECK
+from dmlc_core_tpu.models.histgbt import HistGBT
+from dmlc_core_tpu.models.linear import GBLinear
+
+try:  # real sklearn bases when present: __sklearn_tags__ etc. for
+    # GridSearchCV/Pipeline (sklearn ≥1.6 requires the tags protocol);
+    # plain-object fallback keeps the wrappers import-safe without it
+    from sklearn.base import (BaseEstimator as _SkBase,
+                              ClassifierMixin as _SkClf,
+                              RegressorMixin as _SkReg)
+except ImportError:  # pragma: no cover — sklearn is in the image
+    class _SkBase:  # type: ignore[no-redef]
+        pass
+
+    class _SkClf:  # type: ignore[no-redef]
+        pass
+
+    class _SkReg:  # type: ignore[no-redef]
+        pass
+
+__all__ = ["GBTClassifier", "GBTRegressor", "GBTRanker"]
+
+
+class _EstimatorBase(_SkBase):
+    """Shared param plumbing + booster construction.
+
+    ``get_params``/``set_params`` are overridden (not inherited):
+    sklearn's introspection rejects ``**extra``, which we keep so any
+    native booster knob (gamma, min_child_weight, …) passes through."""
+
+    _objective: str = ""
+
+    def __init__(self, booster: str = "gbtree", n_estimators: int = 100,
+                 max_depth: int = 6, learning_rate: float = 0.3,
+                 n_bins: int = 256, reg_lambda: float = 1.0,
+                 reg_alpha: float = 0.0, subsample: float = 1.0,
+                 colsample_bytree: float = 1.0, seed: int = 0,
+                 **extra: Any):
+        CHECK(booster in ("gbtree", "gblinear"),
+              f"booster must be gbtree|gblinear, got {booster!r}")
+        self.booster = booster
+        self.n_estimators = n_estimators
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_bins = n_bins
+        self.reg_lambda = reg_lambda
+        self.reg_alpha = reg_alpha
+        self.subsample = subsample
+        self.colsample_bytree = colsample_bytree
+        self.seed = seed
+        self._extra = dict(extra)
+        self._model = None
+
+    # -- sklearn estimator contract -------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        out = {k: getattr(self, k) for k in (
+            "booster", "n_estimators", "max_depth", "learning_rate",
+            "n_bins", "reg_lambda", "reg_alpha", "subsample",
+            "colsample_bytree", "seed")}
+        out.update(self._extra)
+        return out
+
+    def set_params(self, **params: Any) -> "_EstimatorBase":
+        for k, v in params.items():
+            if hasattr(self, k) and not k.startswith("_"):
+                setattr(self, k, v)
+            else:
+                self._extra[k] = v
+        return self
+
+    # -- booster construction -------------------------------------------
+    def _make(self, objective: str, num_class: int = 1):
+        # re-validate here, not only in __init__: set_params (e.g. a
+        # GridSearchCV grid) can change booster after construction
+        CHECK(self.booster in ("gbtree", "gblinear"),
+              f"booster must be gbtree|gblinear, got {self.booster!r}")
+        if self.booster == "gblinear":
+            CHECK(objective in ("binary:logistic", "reg:squarederror"),
+                  f"gblinear supports binary/regression objectives, "
+                  f"got {objective!r}")
+            return GBLinear(n_rounds=self.n_estimators,
+                            learning_rate=self.learning_rate,
+                            reg_lambda=self.reg_lambda,
+                            reg_alpha=self.reg_alpha,
+                            objective=objective,
+                            **self._extra)
+        kw: Dict[str, Any] = dict(
+            n_trees=self.n_estimators, max_depth=self.max_depth,
+            learning_rate=self.learning_rate, n_bins=self.n_bins,
+            reg_lambda=self.reg_lambda, subsample=self.subsample,
+            colsample_bytree=self.colsample_bytree,
+            objective=objective, seed=self.seed)
+        if num_class > 1:
+            kw["num_class"] = num_class
+        kw.update(self._extra)
+        return HistGBT(**kw)
+
+    @property
+    def model(self):
+        """The underlying native booster (after fit)."""
+        CHECK(self._model is not None, "call fit first")
+        return self._model
+
+    def save_model(self, uri: str) -> None:
+        self.model.save_model(uri)
+
+
+class GBTClassifier(_SkClf, _EstimatorBase):
+    """Classifier: binary or multiclass chosen from the label set
+    (XGBClassifier semantics)."""
+
+    def fit(self, X: np.ndarray, y: np.ndarray,
+            sample_weight: Optional[np.ndarray] = None,
+            **fit_kw: Any) -> "GBTClassifier":
+        y = np.asarray(y)
+        self.classes_ = np.unique(y)
+        n_class = len(self.classes_)
+        CHECK(n_class >= 2, "need at least 2 classes")
+        codes = np.searchsorted(self.classes_, y).astype(np.float32)
+        if n_class == 2:
+            self._model = self._make("binary:logistic")
+        else:
+            self._model = self._make("multi:softmax", num_class=n_class)
+        self._model.fit(X, codes, weight=sample_weight, **fit_kw)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        raw = self.model.predict(X)
+        if len(self.classes_) == 2:
+            return self.classes_[(np.asarray(raw) > 0.5).astype(int)]
+        return self.classes_[np.asarray(raw).astype(int)]
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        if self.booster == "gblinear":
+            p1 = np.asarray(self.model.predict(X))
+            return np.stack([1.0 - p1, p1], axis=1)
+        return self.model.predict_proba(X)
+
+    def score(self, X: np.ndarray, y: np.ndarray) -> float:
+        """Mean accuracy (sklearn classifier convention)."""
+        return float((self.predict(X) == np.asarray(y)).mean())
+
+
+class GBTRegressor(_SkReg, _EstimatorBase):
+    """Regressor (XGBRegressor analog, reg:squarederror)."""
+
+    def fit(self, X: np.ndarray, y: np.ndarray,
+            sample_weight: Optional[np.ndarray] = None,
+            **fit_kw: Any) -> "GBTRegressor":
+        self._model = self._make("reg:squarederror")
+        self._model.fit(X, np.asarray(y, np.float32),
+                        weight=sample_weight, **fit_kw)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(X))
+
+    def score(self, X: np.ndarray, y: np.ndarray) -> float:
+        """R² (sklearn regressor convention)."""
+        y = np.asarray(y, np.float64)
+        resid = y - self.predict(X)
+        denom = np.var(y) * len(y)
+        return float(1.0 - (resid @ resid) / denom) if denom else 0.0
+
+
+class GBTRanker(_EstimatorBase):
+    """Learning-to-rank (XGBRanker analog, rank:pairwise over qid)."""
+
+    def fit(self, X: np.ndarray, y: np.ndarray, *,
+            qid: np.ndarray, **fit_kw: Any) -> "GBTRanker":
+        CHECK(self.booster == "gbtree",
+              "rank:pairwise needs the tree booster")
+        self._model = self._make("rank:pairwise")
+        self._model.fit(X, np.asarray(y, np.float32), qid=qid, **fit_kw)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(X))
+
+    def score(self, X: np.ndarray, y: np.ndarray, *,
+              qid: np.ndarray, k: Optional[int] = None) -> float:
+        """Mean NDCG@k over queries."""
+        from dmlc_core_tpu.models.ranking import ndcg
+
+        return ndcg(np.asarray(y), self.predict(X), np.asarray(qid), k=k)

@@ -359,6 +359,40 @@ class TestGBTExtras:
                                 n_trees=m.best_iteration + 1)
         np.testing.assert_array_equal(pd_best, pd_explicit)
 
+    def test_predict_leaf_reconstructs_margins(self, rng):
+        """pred_leaf oracle: summing each tree's leaf value at the
+        reported leaf index must reproduce predict(output_margin=True)
+        exactly — the leaf indices ARE the descent predict performs."""
+        X = rng.normal(size=(300, 5)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        m = HistGBT(n_trees=6, max_depth=3, n_bins=16)
+        m.fit(X, y)
+        leaves = m.predict_leaf(X)
+        assert leaves.shape == (300, 6)
+        assert leaves.min() >= 0 and leaves.max() < 2 ** 3
+        margin = np.full(300, m.param.base_score, np.float32)
+        for t, tree in enumerate(m.trees):
+            margin += tree["leaf"][leaves[:, t]]
+        np.testing.assert_allclose(
+            margin, m.predict(X, output_margin=True), rtol=1e-5,
+            atol=1e-6)
+
+    def test_predict_leaf_multiclass(self, rng):
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32) + (X[:, 1] > 0)
+        m = HistGBT(n_trees=3, max_depth=2, n_bins=16,
+                    objective="multi:softmax", num_class=3)
+        m.fit(X, y)
+        leaves = m.predict_leaf(X)
+        assert leaves.shape == (200, 3, 3)          # [n, T, K]
+        margin = np.full((200, 3), m.param.base_score, np.float32)
+        for t, tree in enumerate(m.trees):
+            for c in range(3):
+                margin[:, c] += tree["leaf"][c][leaves[:, t, c]]
+        np.testing.assert_allclose(
+            margin, m.predict(X, output_margin=True), rtol=1e-5,
+            atol=1e-6)
+
     def test_feature_importances(self):
         from dmlc_core_tpu.models import HistGBT
 

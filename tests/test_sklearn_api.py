@@ -1,0 +1,114 @@
+"""sklearn-style estimator wrappers (XGBClassifier-family analog).
+
+Oracles: accuracy/R2/ndcg on learnable synthetics for both boosters;
+label-code round-trips with non-contiguous class labels; param
+round-trip; composition with a real sklearn Pipeline + GridSearchCV
+(sklearn is in the image)."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.models.sklearn import (GBTClassifier, GBTRanker,
+                                          GBTRegressor)
+
+
+def _cls_data(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0)
+    return X, y
+
+
+class TestClassifier:
+    @pytest.mark.parametrize("booster", ["gbtree", "gblinear"])
+    def test_binary_with_string_ish_labels(self, booster):
+        X, yb = _cls_data()
+        y = np.where(yb, "pos", "neg")        # non-numeric labels
+        clf = GBTClassifier(booster=booster, n_estimators=40, max_depth=4)
+        clf.fit(X, y)
+        assert set(np.unique(clf.predict(X))) <= {"pos", "neg"}
+        assert clf.score(X, y) > (0.93 if booster == "gbtree" else 0.80)
+        proba = clf.predict_proba(X)
+        assert proba.shape == (len(X), 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_multiclass_noncontiguous_labels(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(1500, 5)).astype(np.float32)
+        y = np.select([X[:, 0] > 0.5, X[:, 0] < -0.5], [7, 3], default=42)
+        clf = GBTClassifier(n_estimators=20, max_depth=3)
+        clf.fit(X, y)
+        assert sorted(clf.classes_) == [3, 7, 42]
+        assert clf.score(X, y) > 0.95
+        assert set(np.unique(clf.predict(X))) <= {3, 7, 42}
+
+    def test_set_params_invalid_booster_rejected_at_fit(self):
+        # set_params (e.g. a GridSearchCV grid) bypasses __init__; a
+        # typo'd booster must fail loudly at fit, not silently train
+        # the wrong model family
+        from dmlc_core_tpu.base.logging import Error
+
+        X, y = _cls_data(n=64)
+        clf = GBTClassifier(n_estimators=2).set_params(booster="dart")
+        with pytest.raises(Error, match="gbtree|gblinear"):
+            clf.fit(X, y)
+
+    def test_param_roundtrip(self):
+        clf = GBTClassifier(n_estimators=7, gamma=0.5)
+        params = clf.get_params()
+        assert params["n_estimators"] == 7 and params["gamma"] == 0.5
+        clf.set_params(n_estimators=9, gamma=0.1)
+        assert clf.get_params()["n_estimators"] == 9
+        assert clf.get_params()["gamma"] == 0.1
+
+
+class TestRegressor:
+    @pytest.mark.parametrize("booster", ["gbtree", "gblinear"])
+    def test_r2(self, booster):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(2000, 5)).astype(np.float32)
+        y = 2 * X[:, 0] - X[:, 1] + 0.1 * rng.normal(size=2000)
+        reg = GBTRegressor(booster=booster, n_estimators=80)
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.95
+
+
+class TestRanker:
+    def test_ndcg(self):
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=5)
+        Xs, ys, qs = [], [], []
+        for q in range(200):
+            nd = int(rng.integers(6, 14))
+            Xq = rng.normal(size=(nd, 5)).astype(np.float32)
+            rel = np.zeros(nd, np.float32)
+            rel[np.argmax(Xq @ w)] = 2.0
+            Xs.append(Xq)
+            ys.append(rel)
+            qs.append(np.full(nd, q))
+        X, y, qid = (np.concatenate(Xs), np.concatenate(ys),
+                     np.concatenate(qs))
+        rk = GBTRanker(n_estimators=40, max_depth=3, learning_rate=0.3)
+        rk.fit(X, y, qid=qid)
+        assert rk.score(X, y, qid=qid, k=5) > 0.85
+
+
+class TestSklearnComposition:
+    def test_pipeline_and_grid_search(self):
+        sklearn = pytest.importorskip("sklearn")  # noqa: F841
+        from sklearn.model_selection import GridSearchCV
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        X, y = _cls_data(n=800)
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("gbt", GBTClassifier(n_estimators=15, max_depth=3)),
+        ])
+        pipe.fit(X, y)
+        assert pipe.score(X, y) > 0.9
+        gs = GridSearchCV(
+            GBTClassifier(n_estimators=10, max_depth=3),
+            {"max_depth": [2, 3]}, cv=2, scoring="accuracy")
+        gs.fit(X, y)
+        assert gs.best_params_["max_depth"] in (2, 3)
