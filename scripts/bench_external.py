@@ -106,10 +106,11 @@ def main() -> None:
     out["cache_device_boost_seconds"] = round(m.last_fit_seconds, 2)
     out["cache_device_rounds_per_sec"] = round(
         ROUNDS / m.last_fit_seconds, 3)
-    out["chunk_seconds_per_round"] = [
-        round((t2 - t1) / (d2 - d1), 4)
-        for (d1, t1), (d2, t2) in zip([(0, 0.0)] + m.last_chunk_times,
-                                      m.last_chunk_times)]
+    # one chunk-rate implementation repo-wide: the anomaly flag applies
+    # to this capture too (same tunnel, same failure mode)
+    from bench import chunk_stats
+    out.update(chunk_stats(m.last_chunk_times, ROUNDS,
+                           m.last_fit_seconds))
     out["rss_after_cached_fit_gb"] = round(rss_gb(), 2)
 
     # true out-of-core page loop, a few rounds (device memory bounded by

@@ -1,0 +1,94 @@
+"""bench.py anomaly machinery + rank-objective autodiff oracle.
+
+The official BENCH record's trustworthiness rests on chunk_stats
+flagging tunnel-degraded captures; that logic must be tested, not just
+shipped.  The second half verifies the RankNet pairwise gradients
+against jax.grad/jax.hessian of the explicitly-summed pairwise loss —
+an oracle stronger than the learning tests."""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import chunk_stats  # noqa: E402
+
+
+class TestChunkStats:
+    def test_uniform_chunks_no_anomaly(self):
+        ct = [(25, 3.0), (50, 6.1), (75, 9.1), (100, 12.2)]
+        s = chunk_stats(ct, 100, 12.2)
+        assert s["anomaly"] is False
+        assert abs(s["rounds_per_sec_median_chunk"] - 25 / 3.05) < 0.2
+        assert len(s["chunk_seconds_per_round"]) == 4
+
+    def test_degraded_chunk_flags_anomaly(self):
+        # one wedged dispatch: 25 rounds took 40s instead of ~3s —
+        # the round-2 capture signature
+        ct = [(25, 3.0), (50, 43.0), (75, 46.0), (100, 49.0)]
+        s = chunk_stats(ct, 100, 49.0)
+        assert s["anomaly"] is True
+        # best-chunk still reports the healthy rate
+        assert s["rounds_per_sec_best_chunk"] > 8.0
+
+    def test_single_chunk_cannot_flag(self):
+        s = chunk_stats([(25, 3.0)], 25, 3.0)
+        assert s["anomaly"] is False
+
+    def test_empty_falls_back_to_wall(self):
+        s = chunk_stats([], 100, 50.0)
+        assert s["anomaly"] is False
+        assert s["rounds_per_sec_best_chunk"] == 2.0
+
+    def test_threshold_boundary(self):
+        # exactly 3.0x is NOT an anomaly; just above is
+        at = chunk_stats([(10, 1.0), (20, 4.0)], 20, 4.0)
+        assert at["anomaly"] is False            # ratio == 3.0
+        above = chunk_stats([(10, 1.0), (20, 4.2)], 20, 4.2)
+        assert above["anomaly"] is True
+
+
+class TestPairwiseRankAutodiffOracle:
+    def test_grad_and_hessian_match_autodiff(self):
+        """g must equal jax.grad of the summed pairwise loss and h the
+        exact diagonal of its Hessian (RankNet's per-pair rho sums ARE
+        the diagonal, not an approximation)."""
+        from dmlc_core_tpu.models.histgbt import _PairwiseRank
+
+        rng = np.random.default_rng(0)
+        G, Q = 5, 3
+        obj = _PairwiseRank(G, block_queries=2)  # exercises query padding
+        pred = jnp.asarray(rng.normal(size=Q * G).astype(np.float32))
+        rel = rng.integers(0, 3, size=Q * G).astype(np.float32)
+        rel[::7] = -1.0                          # pad docs must drop out
+        rel_j = jnp.asarray(rel)
+
+        def total_loss(s):
+            sq = s.reshape(Q, G)
+            rq = rel_j.reshape(Q, G)
+            loss = 0.0
+            for q in range(Q):
+                for i in range(G):
+                    for j in range(G):
+                        better = ((rq[q, i] > rq[q, j])
+                                  & (rq[q, i] >= 0) & (rq[q, j] >= 0))
+                        loss = loss + jnp.where(
+                            better,
+                            jnp.logaddexp(0.0, -(sq[q, i] - sq[q, j])),
+                            0.0)
+            return loss
+
+        g, h = obj.grad_hess(pred, rel_j)
+        g_ref = jax.grad(total_loss)(pred)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-6)
+        h_ref = jnp.diag(jax.hessian(total_loss)(pred))
+        # h floors at 1e-16 for pairless docs; the oracle's true 0s
+        # compare within atol
+        np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref),
+                                   rtol=1e-4, atol=1e-5)
