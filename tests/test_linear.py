@@ -92,6 +92,27 @@ class TestGBLinear:
                                    atol=2e-6)
         np.testing.assert_allclose(m8.bias, m1.bias, rtol=2e-4, atol=2e-6)
 
+    def test_fit_iter_matches_in_core(self, tmp_path):
+        # LibSVM pages through RowBlockIter must train the same model
+        # as the dense in-core path
+        from dmlc_core_tpu.data.iter import RowBlockIter
+
+        X, yc, _ = _linear_problem(n=1200, F=4)
+        y = (yc > 0.3).astype(np.float32)
+        svm = tmp_path / "lin.svm"
+        with open(svm, "w") as f:
+            for i in range(len(y)):
+                feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(4))
+                f.write(f"{int(y[i])} {feats}\n")
+        it = RowBlockIter.create(str(svm), 0, 1, "libsvm")
+        m_it = GBLinear(n_rounds=40, objective="binary:logistic")
+        m_it.fit_iter(it, num_col=4)
+        it.close()
+        m_core = GBLinear(n_rounds=40, objective="binary:logistic")
+        m_core.fit(X, y)
+        np.testing.assert_allclose(m_it.weights, m_core.weights,
+                                   rtol=1e-4, atol=1e-5)
+
     def test_save_load_roundtrip(self, tmp_path):
         X, yc, _ = _linear_problem(n=1000)
         m = GBLinear(n_rounds=20, objective="reg:squarederror")
