@@ -66,6 +66,13 @@ class RowBlockIter:
     def num_col(self) -> int:
         raise NotImplementedError
 
+    @property
+    def num_rows(self) -> Optional[int]:
+        """Total rows, when known without a decode pass (None otherwise).
+        Consumers sizing a preallocation (GBLinear.fit_iter) use this to
+        avoid re-reading the whole input just to count."""
+        return None
+
     def close(self) -> None:
         pass
 
@@ -102,6 +109,10 @@ class BasicRowIter(RowBlockIter):
     def num_col(self) -> int:
         return self._max_index + 1
 
+    @property
+    def num_rows(self) -> int:
+        return self._block.size
+
 
 class DiskRowIter(RowBlockIter):
     """Parse once to binary pages on a cache URI; iterate pages with
@@ -112,6 +123,7 @@ class DiskRowIter(RowBlockIter):
         self._cache_uri = cache_uri
         self._max_index = 0
         self._num_pages = 0
+        self._num_rows = 0
         self._build_cache(parser, page_bytes)
         self._iter: Optional[ThreadedIter] = None
         self._read_stream: Optional[Stream] = None
@@ -122,6 +134,7 @@ class DiskRowIter(RowBlockIter):
         held = 0
         for block in parser:
             container.push_block(block)
+            self._num_rows += block.size
             held += block.memory_cost()
             if held >= page_bytes:
                 container.save(out)
@@ -175,6 +188,10 @@ class DiskRowIter(RowBlockIter):
     @property
     def num_col(self) -> int:
         return self._max_index + 1
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
 
     def close(self) -> None:
         self._stop_reader()

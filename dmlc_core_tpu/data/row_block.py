@@ -146,10 +146,31 @@ class RowBlock:
         """Densify to float32 [n, num_col] (missing → 0)."""
         ncol = num_col if num_col is not None else self.max_index + 1
         out = np.zeros((self.size, ncol), dtype=np.float32)
-        rows = np.repeat(np.arange(self.size), np.diff(self.offset))
-        vals = self.value if self.value is not None else np.ones(self.nnz, np.float32)
-        out[rows, self.index] = vals
+        self.to_dense_into(out)
         return out
+
+    def to_dense_into(self, out: np.ndarray,
+                      chunk_rows: int = 1 << 20) -> None:
+        """Scatter this block into a preallocated float32 ``[size, F]``
+        array in bounded row chunks.
+
+        For a whole-dataset block (BasicRowIter slurps everything into
+        one RowBlock) ``to_dense`` would build nnz-sized scatter
+        temporaries for the full dataset at once; chunking bounds the
+        transient to ``chunk_rows`` worth regardless of block size —
+        the consumer (e.g. GBLinear.fit_iter) writes straight into its
+        slice of one preallocated matrix."""
+        CHECK_EQ(out.shape[0], self.size, "to_dense_into: row mismatch")
+        for s in range(0, self.size, chunk_rows):
+            e = min(s + chunk_rows, self.size)
+            o0, o1 = int(self.offset[s]), int(self.offset[e])
+            rows = np.repeat(np.arange(e - s),
+                             np.diff(self.offset[s:e + 1]))
+            sl = out[s:e]
+            sl.fill(0.0)
+            vals = (self.value[o0:o1] if self.value is not None
+                    else np.ones(o1 - o0, np.float32))
+            sl[rows, self.index[o0:o1]] = vals
 
 
 class RowBlockContainer(Serializable):

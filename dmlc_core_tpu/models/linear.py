@@ -218,12 +218,15 @@ class GBLinear:
         F = max(num_col or 0, row_iter.num_col)
         CHECK(F > 0, "fit_iter: no columns (num_col unset and the "
                      "iterator reports width 0)")
-        # two STREAMING passes (RowBlockIter rewinds): count rows, then
-        # densify each block straight into its slice of ONE preallocated
-        # matrix.  One block resident at a time — accumulating blocks or
-        # concatenating dense pages would transiently hold ~2× the
-        # stated residency
-        n = sum(b.size for b in row_iter)
+        # row count from iterator metadata when available (BasicRowIter
+        # and DiskRowIter track it), else one counting pass; then each
+        # block scatters straight into its slice of ONE preallocated
+        # matrix in bounded chunks (to_dense_into) — no full-dataset
+        # dense temporary even for BasicRowIter's single whole-data
+        # block
+        n = row_iter.num_rows
+        if n is None:
+            n = sum(b.size for b in row_iter)
         CHECK(n > 0, "fit_iter: iterator yielded no rows")
         X = np.empty((n, F), np.float32)
         y = np.empty(n, np.float32)
@@ -231,11 +234,11 @@ class GBLinear:
         lo = 0
         for b in row_iter:
             hi = lo + b.size
-            X[lo:hi] = b.to_dense(F)
+            b.to_dense_into(X[lo:hi])
             y[lo:hi] = b.label
             w[lo:hi] = (b.weight if b.weight is not None else 1.0)
             lo = hi
-        CHECK_EQ(lo, n, "fit_iter: iterator changed size between passes")
+        CHECK_EQ(lo, n, "fit_iter: iterator row count inconsistent")
         return self.fit(X, y, weight=w, warmup_rounds=warmup_rounds)
 
     # -- inference ------------------------------------------------------
