@@ -145,7 +145,9 @@ class RowBlock:
     def to_dense(self, num_col: Optional[int] = None) -> np.ndarray:
         """Densify to float32 [n, num_col] (missing → 0)."""
         ncol = num_col if num_col is not None else self.max_index + 1
-        out = np.zeros((self.size, ncol), dtype=np.float32)
+        # np.empty, not zeros: to_dense_into zero-fills each chunk
+        # itself, so zeros here would write every byte twice
+        out = np.empty((self.size, ncol), dtype=np.float32)
         self.to_dense_into(out)
         return out
 

@@ -13,8 +13,9 @@ once:
     delta_j = lr * ( -(Σ_i g_i·x_ij + λ·w_j) / (Σ_i h_i·x_ij² + λ) )
 
 with an elastic-net soft-threshold for the L1 term (``alpha``).  One
-round = grad/hess (elementwise) + TWO matmuls (``Xᵀg`` and ``Xᵀh·X²``
-via a precomputed X² matrix) + one [F] ``psum`` across the data mesh —
+round = grad/hess (elementwise) + the ``Xᵀg`` matvec + a fused
+multiply-reduce for ``Σ h·x²`` (never materializing X² — a dot operand
+would, doubling HBM residency) + one [F] ``psum`` across the data mesh —
 the same in-step collective shape as the histogram sync, a few hundred
 bytes per round.  Rounds run in lax.scan chunks per dispatch with the
 same per-chunk arrival evidence as hist-GBT (remote-tunnel honesty).
@@ -95,20 +96,20 @@ class GBLinear:
         alpha = p.reg_alpha
 
         def k_rounds(x_l, y_l, w_l, wvec, bias):
-            # X² derived on device per dispatch (one fused elementwise
-            # op) instead of shipping a second full copy of the dataset
-            # over H2D
-            x2_l = x_l * x_l
-
             def one_round(carry, _):
                 wv, b = carry
                 margin = x_l @ wv + b
                 g, h = obj.grad_hess(margin, y_l)
                 g = g * w_l
                 h = h * w_l
-                # [F] reductions: the only collectives in the round
+                # [F] reductions: the only collectives in the round.
+                # hsum as an elementwise-chain reduction (NOT h @ (x·x)):
+                # a dot operand must materialize, and a full X² beside X
+                # doubles HBM residency — 2×7.8 GB at 50M×39 overflows a
+                # 16 GB chip; the fused multiply-reduce streams X once
                 gsum = jax.lax.psum(g @ x_l, "data")         # Σ g·x_j
-                hsum = jax.lax.psum(h @ x2_l, "data")        # Σ h·x_j²
+                hsum = jax.lax.psum(
+                    (h[:, None] * x_l * x_l).sum(axis=0), "data")
                 gb = jax.lax.psum(jnp.sum(g), "data")
                 hb = jax.lax.psum(jnp.sum(h), "data")
                 # per-coordinate quadratic model around wv:
