@@ -232,6 +232,26 @@ class _PairwiseRank(_ObjectiveBase):
         return losses.sum() / jnp.maximum(counts.sum(), 1)
 
 
+def _host_bin_device():
+    """Device of the DMLC_TPU_BIN_BACKEND override (None = bin where the
+    data lives).  Through a remote-device tunnel, host binning uploads
+    the 4×-smaller uint8 matrix instead of f32 features; see the call
+    sites for the measured trade-offs."""
+    from dmlc_core_tpu.base.parameter import get_env
+
+    backend = get_env("DMLC_TPU_BIN_BACKEND", "", str)
+    return jax.local_devices(backend=backend)[0] if backend else None
+
+
+def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray, dev) -> np.ndarray:
+    """Bin ``X`` on ``dev`` and return the FEATURE-major uint8 matrix as
+    one host array (transpose inside the jax call — a NumPy .T +
+    ascontiguousarray would hold a second full copy)."""
+    with jax.default_device(dev):
+        return np.asarray(apply_bins(jnp.asarray(X),
+                                     jnp.asarray(cuts_np)).T)
+
+
 def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
                      with_child_sums: bool = False,
                      mono: Optional[np.ndarray] = None):
@@ -580,18 +600,35 @@ class HistGBT:
 
         row_sharding = NamedSharding(self.mesh, P("data"))
         mat_sharding = NamedSharding(self.mesh, P("data", None))
-        bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
-        # the round program wants bins FEATURE-major ([F, n], rows on
-        # lanes): the Pallas histogram kernel then reads its native
-        # layout directly instead of re-transposing the matrix inside
-        # every boosting round (a full HBM round-trip per round)
-        bins_t = jax.jit(
-            lambda b: b.T,
-            out_shardings=NamedSharding(self.mesh, P(None, "data")))(bins)
-        if not continuing:
+        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_device) uploads the
+        # uint8 result — 4× less transfer than shipping f32 X to bin on
+        # device.  Measured trade-off at 2M×28 through the 12-17 MB/s
+        # axon tunnel on a 1-core host: device path 26.7 s setup vs
+        # host path 38.2 s (identical margins) — single-core binning
+        # outweighs the transfer saving HERE, so the knob stays opt-in
+        # for hosts with cores or slower links; default (unset) is the
+        # device path.  The warm-start branch needs the row-major f32
+        # upload anyway, so it always bins on device.
+        bin_dev = _host_bin_device()
+        if bin_dev is not None and not continuing:
+            bins = None
+            bins_t = jax.device_put(
+                _host_bin_t(X, np.asarray(self.cuts), bin_dev),
+                NamedSharding(self.mesh, P(None, "data")))
+        else:
+            bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
+            # the round program wants bins FEATURE-major ([F, n], rows on
+            # lanes): the Pallas histogram kernel then reads its native
+            # layout directly instead of re-transposing the matrix inside
+            # every boosting round (a full HBM round-trip per round)
+            bins_t = jax.jit(
+                lambda b: b.T,
+                out_shardings=NamedSharding(self.mesh, P(None, "data")))(bins)
+        if not continuing and bins is not None:
             # only the warm-start branch below reads the row-major copy;
             # otherwise drop it now — keeping both layouts would double
             # the binned matrix's HBM residency for the whole fit
+            # (host-binned path never made one)
             bins.delete()
             del bins
         y_d = jax.device_put(y, row_sharding)
@@ -883,22 +920,18 @@ class HistGBT:
         # -- pass 2: bin pages (uint8, FEATURE-major like fit()) -----------
         K_cls = p.num_class
         pages: List[Dict[str, Any]] = []   # "bins" is a jax.Array when cache_device
-        # DMLC_TPU_BIN_BACKEND=cpu bins pages on the host backend and
-        # uploads nothing per page: through a remote-device tunnel, 365
-        # per-page f32 uploads cost seconds each, while the cached path
-        # re-uploads the 4x-smaller uint8 matrix ONCE at concat time.
-        # On a locally attached chip leave it unset (device binning).
-        from dmlc_core_tpu.base.parameter import get_env
-        bin_backend = get_env("DMLC_TPU_BIN_BACKEND", "", str)
-        bin_dev = (jax.local_devices(backend=bin_backend)[0]
-                   if bin_backend else None)
+        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_device) bins pages on
+        # the host backend and uploads nothing per page: through a
+        # remote-device tunnel, 365 per-page f32 uploads cost seconds
+        # each, while the cached path re-uploads the 4x-smaller uint8
+        # matrix ONCE at concat time.  On a locally attached chip leave
+        # it unset (device binning).
+        bin_dev = _host_bin_device()
         cuts_for_bin = np.asarray(self.cuts) if bin_dev is not None else None
         for block in row_iter:
             X = block.to_dense(F)
             if bin_dev is not None:
-                with jax.default_device(bin_dev):
-                    bins = np.asarray(apply_bins(
-                        jnp.asarray(X), jnp.asarray(cuts_for_bin)).T)
+                bins = _host_bin_t(X, cuts_for_bin, bin_dev)
             else:
                 bins = apply_bins(jnp.asarray(X), self.cuts).T  # [F, rows]
                 if not cache_device:
