@@ -93,6 +93,23 @@ class TestRanker:
         assert rk.score(X, y, qid=qid, k=5) > 0.85
 
 
+class TestWrapperCheckpoint:
+    def test_save_model_passthrough(self, tmp_path):
+        """wrapper.save_model writes the native booster's checkpoint;
+        the native load_model reads it back and predicts identically."""
+        from dmlc_core_tpu.models import HistGBT
+
+        X, yb = _cls_data(n=400)
+        clf = GBTClassifier(n_estimators=5, max_depth=3)
+        clf.fit(X, yb.astype(int))
+        uri = str(tmp_path / "wrapped.ckpt")
+        clf.save_model(uri)
+        native = HistGBT.load_model(uri)
+        np.testing.assert_allclose(
+            native.predict(X, output_margin=True),
+            clf.model.predict(X, output_margin=True), rtol=1e-6)
+
+
 class TestSklearnComposition:
     def test_pipeline_and_grid_search(self):
         sklearn = pytest.importorskip("sklearn")  # noqa: F841
