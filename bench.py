@@ -7,9 +7,34 @@ binary labels — quantile-binned once, then ``BENCH_ROUNDS`` boosting rounds
 of depth ``BENCH_DEPTH`` after ``BENCH_WARMUP`` discarded warmup rounds
 (compile + cache), per BASELINE.md's measurement plan.
 
-Prints ONE JSON line:
-  {"metric": "histgbt_rounds_per_sec_per_chip", "value": N,
-   "unit": "rounds/s/chip", "vs_baseline": N, ...}
+Output protocol (driver parses the LAST stdout line as JSON): this script
+emits a *provisional* JSON line at every phase transition and at every
+timed-chunk arrival, then one final line.  Whatever kills the process —
+driver timeout (SIGTERM), our own wall-clock budget, SIGKILL — the last
+line on stdout is always a valid record carrying the evidence gathered so
+far.  Two earlier rounds lost their official capture to exactly this
+failure mode (r02: tunnel-degraded number with no trace; r03: rc=124 with
+empty stdout), so survivability is part of the bench's spec, not polish.
+
+Robustness machinery:
+  * ``BENCH_TIME_BUDGET`` (s, default 480): an internal deadline enforced
+    by a watchdog *thread* (signal handlers can't run while the main
+    thread is blocked inside a C-land device fetch; a thread can).  On
+    expiry the evidence-so-far is flushed as the final line and the
+    process exits 0.
+  * SIGTERM/SIGINT handlers flush the same way (the driver's `timeout`
+    sends SIGTERM first).
+  * Config fallback: if the remaining budget can't fit the requested
+    rows (datagen + H2D at the measured 12 MB/s tunnel floor + compile +
+    timed fit), rows fall back 10M→4M→2M→1M and the JSON says so
+    (``fallback_from``).  Rounds shrink the same way if needed.
+  * The anomaly re-measure (tunnel-degradation signature: worst/best
+    chunk ratio > 3) reuses the device-resident binned matrix via
+    ``HistGBT.fit_device`` — zero re-upload — and is skipped entirely
+    when the budget can't fit it.
+  * Official-run selection prefers the NON-anomalous run; if every run
+    is anomalous the median-chunk rate is reported (``value_basis`` says
+    which), never a corrupted wall number and never best-of-2.
 
 vs_baseline: the reference publishes no numbers (SURVEY.md §6); the target
 is the BASELINE.json north star — XGBoost+NCCL on one 8×A100 node at
@@ -22,21 +47,155 @@ giving an aggregate ≈ 16-34 rounds/s → **2.0 rounds/s per chip** as the
 mid-band per-GPU effective rate.  vs_baseline = value / 2.0.  This
 environment has no network and no xgboost wheel, so the comparator is
 pinned from cited public figures, not re-measured here.
+
+Extra smoke fields (BASELINE configs 2/4, budget-gated, null on skip):
+``infeed_stall_frac`` — DeviceFeed double-buffered infeed stall fraction
+on a small synthetic stream; ``kvstore_sync_ms`` — KVStore dist_sync
+fused push+pull per step on a small BERT-shaped key set.  Full-scale
+versions live in scripts/bench_kvstore.py / tests/test_resnet_feed.py.
 """
 
 import json
 import os
+import signal
 import sys
+import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
 
+_COMPARATOR = 2.0          # rounds/s/chip, BASELINE.md mid-band
+_TUNNEL_MBPS = 12e6        # measured H2D floor through the axon tunnel
+# RLock: a SIGTERM handler runs ON the main thread and re-enters emit()
+# if the signal lands mid-print; a plain Lock would self-deadlock there
+_EMIT_LOCK = threading.RLock()
 
 #: bf16 peak of the chips this bench is expected to land on, for the MFU
 #: line.  v5e: 197 TFLOP/s bf16 (public spec).  Unknown platforms → 0 →
 #: mfu reported as null rather than against a made-up peak.
 _PEAK_BF16 = {"tpu": 197e12}
+
+#: single shared evidence store; emit() renders it as one JSON line.
+#: Written only by the main thread; read by the watchdog thread and
+#: signal handlers.  Cross-thread safety contract: container VALUES are
+#: only ever REBOUND wholesale (never mutated in place, except list
+#: .append which cannot raise mid-iteration in CPython) — a concurrent
+#: emit() therefore never sees a dict change size under iteration.
+EV = {
+    "phase": "start",
+    "t0": None,              # process start (time.time())
+    "config": {},            # rows/feats/rounds/... once chosen
+    "platform": None,
+    "chunk_times": [],       # (rounds_done, elapsed_s) of the LIVE run
+    "runs": [],              # completed run evidence dicts
+    "official": None,        # final selection
+    "value_basis": None,
+    "fallback_from": None,
+    "smoke": {},
+    "notes": [],
+}
+
+
+def _elapsed():
+    return time.time() - EV["t0"] if EV["t0"] else 0.0
+
+
+def _live_estimate():
+    """Best per-CHIP rate estimate from the in-flight run's chunk
+    arrivals (the metric is per chip: divide the mesh rate out, exactly
+    as the official paths do)."""
+    ct = EV["chunk_times"]
+    if not ct:
+        return None
+    done, t = ct[-1]
+    if t <= 0:
+        return None
+    return done / t / EV["config"].get("chips", 1)
+
+
+def emit(final=False, **extra):
+    """Print one JSON evidence line (the driver reads the LAST line)."""
+    cfg = EV["config"]
+    value = 0.0
+    basis = None
+    if EV["official"] is not None:
+        value = EV["official"]["value"]
+        basis = EV["value_basis"]
+    else:
+        live = _live_estimate()
+        if live is not None:
+            value = live
+            basis = "wall_so_far"
+    out = {
+        "metric": "histgbt_rounds_per_sec_per_chip",
+        "value": round(value, 4),
+        "unit": "rounds/s/chip",
+        "vs_baseline": round(value / _COMPARATOR, 4),
+        "provisional": not final,
+        "phase": EV["phase"],
+        "elapsed_s": round(_elapsed(), 1),
+        "platform": EV["platform"],
+    }
+    if basis:
+        out["value_basis"] = basis
+    out.update(cfg)
+    if EV["fallback_from"]:
+        out["fallback_from"] = EV["fallback_from"]
+    if EV["chunk_times"] and EV["official"] is None:
+        out["chunks_so_far"] = [[d, round(t, 3)] for d, t in
+                                EV["chunk_times"]]
+    if EV["official"] is not None:
+        out.update(EV["official"])
+        out["value"] = round(value, 4)          # official dict also has it
+        out["vs_baseline"] = round(value / _COMPARATOR, 4)
+        out["vs_baseline_band"] = [round(value / 4.0, 4),
+                                   round(value / 2.0, 4)]
+        out["runs"] = EV["runs"]
+    for k, v in EV["smoke"].items():
+        out[k] = v
+    if EV["notes"]:
+        out["notes"] = EV["notes"]
+    out.update(extra)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(out) + "\n")
+        sys.stdout.flush()
+
+
+def _flush_and_exit(reason):
+    try:
+        emit(final=True, terminated=reason)
+    except Exception as e:  # noqa: BLE001 — the record must still exist
+        with _EMIT_LOCK:
+            sys.stdout.write(json.dumps({
+                "metric": "histgbt_rounds_per_sec_per_chip",
+                "value": 0.0, "unit": "rounds/s/chip", "vs_baseline": 0.0,
+                "terminated": reason, "provisional": False,
+                "emit_error": f"{type(e).__name__}: {e}"[:200]}) + "\n")
+            sys.stdout.flush()
+    os._exit(0)
+
+
+def _install_guards(deadline):
+    """SIGTERM/SIGINT flush + watchdog thread enforcing the deadline.
+
+    The watchdog is a thread, not SIGALRM: a Python signal handler only
+    runs between bytecodes on the main thread, and the main thread spends
+    minutes at a time blocked inside C-land device fetches through the
+    tunnel — exactly when the budget is most likely to expire."""
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda s, f: _flush_and_exit(
+            signal.Signals(s).name))
+
+    def watch():
+        while True:
+            left = deadline - time.time()
+            if left <= 0:
+                _flush_and_exit("budget_exhausted")
+            time.sleep(min(5.0, max(0.5, left)))
+
+    threading.Thread(target=watch, daemon=True).start()
 
 
 def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
@@ -79,13 +238,19 @@ def chunk_stats(chunk_times, total_rounds, total_seconds):
     """Per-chunk rate evidence from (rounds_done, t) arrival timestamps.
 
     Returns best/median/worst seconds-per-round and the anomaly flag
-    (worst/best > 3 — the tunnel-degradation signature that made the
-    round-2 official capture 68× wrong with no trace).  Pure so the
-    anomaly machinery itself is unit-testable (tests/test_bench_stats)."""
+    (worst/best > 3 AND worst > 50 ms/round — a tunnel stall is a
+    dispatch sitting for hundreds of ms to minutes, the signature that
+    made the round-2 official capture 68× wrong with no trace; the
+    absolute floor stops a near-zero timer delta on a fast local fit
+    from flagging its sibling chunks as "slow").  Deltas are also
+    clamped to 1 µs so a coarse timer can never divide-by-zero.  Pure
+    so the anomaly machinery itself is unit-testable
+    (tests/test_bench_stats)."""
+    eps = 1e-6
     spr = []
     prev_done, prev_t = 0, 0.0
     for done_i, t_i in chunk_times:
-        spr.append((t_i - prev_t) / (done_i - prev_done))
+        spr.append(max(t_i - prev_t, eps) / (done_i - prev_done))
         prev_done, prev_t = done_i, t_i
     # wall fallback only when there is no chunk evidence at all
     spr_sorted = sorted(spr) or [total_seconds / total_rounds]
@@ -95,20 +260,138 @@ def chunk_stats(chunk_times, total_rounds, total_seconds):
         "rounds_per_sec_best_chunk": round(1.0 / spr_sorted[0], 4),
         "rounds_per_sec_median_chunk": round(1.0 / med, 4),
         "anomaly": (len(spr) >= 2
-                    and spr_sorted[-1] / spr_sorted[0] > 3.0),
+                    and spr_sorted[-1] / spr_sorted[0] > 3.0
+                    and spr_sorted[-1] > 0.05),
     }
 
 
-def main() -> None:
-    # default = the north-star config (BASELINE.md config 1): HIGGS-10M
+def _setup_estimate(rows, feats, rounds):
+    """Pessimistic seconds to reach the end of the timed fit: datagen on
+    one core + f32 H2D at the measured tunnel floor + compile/warmup +
+    the fit itself at the measured per-row rate (8 r/s at 10M)."""
+    bytes_x = rows * feats * 4
+    datagen = bytes_x / 60e6
+    upload = bytes_x / _TUNNEL_MBPS + rows * 8 / _TUNNEL_MBPS
+    compile_warm = 75.0
+    spr = max(rows * 1.25e-8, 0.005)
+    return datagen + upload + compile_warm + rounds * spr
+
+
+def _pick_config(budget_left):
+    """Choose rows/rounds that fit the remaining budget (with margin for
+    the final fetch + smoke lines), falling back from the requested
+    config and recording the decision."""
     rows = int(os.environ.get("BENCH_ROWS", 10_000_000))
     feats = int(os.environ.get("BENCH_FEATURES", 28))
     rounds = int(os.environ.get("BENCH_ROUNDS", 100))
+    requested = rows
+    chain = [requested] + [c for c in (4_000_000, 2_000_000, 1_000_000,
+                                       250_000) if c < requested]
+    for cand in chain:
+        if _setup_estimate(cand, feats, rounds) <= budget_left - 60:
+            rows = cand
+            break
+    else:
+        rows = chain[-1]
+    if rows != requested:
+        EV["fallback_from"] = requested
+        EV["notes"].append(
+            f"budget {budget_left:.0f}s left cannot fit rows={requested} "
+            f"(est {_setup_estimate(requested, feats, rounds):.0f}s); "
+            f"fell back to rows={rows}")
+    if _setup_estimate(rows, feats, rounds) > budget_left - 60:
+        # rows have bottomed out and it STILL doesn't fit: shrink the
+        # round count to what the leftover fit window can hold
+        setup_only = _setup_estimate(rows, feats, 0)
+        spr = max(rows * 1.25e-8, 0.005)
+        fit_window = budget_left - 60 - setup_only
+        new_rounds = max(25, int(fit_window / spr)) if fit_window > 0 else 25
+        if new_rounds < rounds:
+            EV["notes"].append(
+                f"rounds fallback {rounds}->{new_rounds}: setup alone "
+                f"needs ~{setup_only:.0f}s of the {budget_left:.0f}s left")
+            rounds = new_rounds
+    return rows, feats, rounds
+
+
+def _smoke_infeed(mesh):
+    """BASELINE config-2 smoke: DeviceFeed stall fraction on a small
+    synthetic stream with a jitted consumer (full-scale:
+    tests/test_resnet_feed.py / examples/resnet_recordio.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.data.device_feed import DeviceFeed
+
+    rng = np.random.default_rng(1)
+    n_batches, B, D = 24, 2048, 128
+
+    def host_iter():
+        for _ in range(n_batches):
+            yield (rng.normal(size=(B, D)).astype(np.float32),)
+
+    w = jnp.asarray(rng.normal(size=(D, D)).astype(np.float32))
+    step = jax.jit(lambda x, w: jnp.sum(jnp.tanh(x @ w)))
+    out = None
+    with DeviceFeed(host_iter, mesh, depth=2) as feed:
+        for (x,) in feed:
+            out = step(x, w)
+        np.asarray(out)          # real fetch: proves the pipe drained
+        return round(feed.stats.stall_fraction(), 4)
+
+
+def _smoke_kvstore(mesh):
+    """BASELINE config-4 smoke: fused dist_sync push+pull ms/step on a
+    small BERT-shaped key set (full-scale: scripts/bench_kvstore.py —
+    the collective COUNT contrast needs the 8-way mesh; this field
+    records the fused sync path's per-step cost on the bench device)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.parallel.kvstore import KVStore
+
+    W = mesh.devices.size
+    hidden = 128
+    shapes = [("embed", (4000, hidden))]
+    for i in range(12):
+        shapes += [(f"l{i}.w1", (hidden, 4 * hidden)),
+                   (f"l{i}.w2", (4 * hidden, hidden)),
+                   (f"l{i}.b", (hidden,))]
+    rng = np.random.default_rng(2)
+    sh = NamedSharding(mesh, P("data"))
+    grads = {k: jax.device_put(
+        rng.normal(size=(W, *s)).astype(np.float32) / W, sh)
+        for k, s in shapes}
+    kv = KVStore.create("dist_sync", mesh=mesh, learning_rate=0.01)
+    keys = [k for k, _ in shapes]
+    kv.init(keys, [np.zeros(s, np.float32) for _, s in shapes])
+    kv.push(keys, [grads[k] for k in keys])    # warm the jit caches
+    out = kv.pull(keys)
+    np.asarray(out[0][:1])
+    steps = 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        kv.push(keys, [grads[k] for k in keys])
+        out = kv.pull(keys)
+    np.asarray(out[0][:1])                     # tunnel-proof sync
+    return round((time.perf_counter() - t0) / steps * 1e3, 2)
+
+
+def main() -> None:
+    EV["t0"] = time.time()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
+    deadline = EV["t0"] + budget
+    _install_guards(deadline)
+
     warmup = int(os.environ.get("BENCH_WARMUP", 10))
     depth = int(os.environ.get("BENCH_DEPTH", 6))
     n_bins = int(os.environ.get("BENCH_BINS", 256))
 
-    import threading
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # self-test hook: the axon TPU plugin overrides JAX_PLATFORMS,
+        # so tests must pin CPU through the supported entry point
+        from dmlc_core_tpu.utils import force_cpu_devices
+        force_cpu_devices(int(os.environ["BENCH_FORCE_CPU"]))
 
     import jax
 
@@ -116,9 +399,15 @@ def main() -> None:
     from dmlc_core_tpu.parallel.mesh import local_mesh
 
     # Backend-init watchdog: if the TPU tunnel is wedged, device discovery
-    # hangs in C land; fall back to CPU so the bench always emits its JSON
-    # line (platform is recorded so a fallback run is visible).
-    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
+    # hangs in C land; fall back with an explanatory record rather than
+    # hanging past the driver's patience.
+    EV["phase"] = "probe"
+    emit()
+    # floor of 20s even under a tiny budget: the watchdog thread owns the
+    # global deadline; this timeout only exists to produce a *descriptive*
+    # wedged-tunnel record when there is still budget to continue in
+    init_timeout = max(min(float(os.environ.get("BENCH_INIT_TIMEOUT", 180)),
+                           deadline - time.time() - 30), 20.0)
     probe: dict = {}
 
     def _probe():
@@ -131,18 +420,17 @@ def main() -> None:
     t.start()
     t.join(init_timeout)
     if "devices" not in probe:
-        print(json.dumps({
-            "metric": "histgbt_rounds_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "rounds/s/chip",
-            "vs_baseline": 0.0,
-            "error": f"device init did not complete in {init_timeout}s "
-                     f"(TPU tunnel wedged?): {probe.get('error', 'timeout')}",
-        }), flush=True)
+        emit(final=True, error=(
+            f"device init did not complete in {init_timeout:.0f}s "
+            f"(TPU tunnel wedged?): {probe.get('error', 'timeout')}"))
         os._exit(2)
+    EV["platform"] = probe["devices"][0].platform
 
-    devices = probe["devices"]
-    platform = devices[0].platform
+    rows, feats, rounds = _pick_config(deadline - time.time())
+    EV["config"] = {"rows": rows, "features": feats, "rounds": rounds,
+                    "max_depth": depth, "n_bins": n_bins}
+    EV["phase"] = "datagen"
+    emit()
 
     # HIGGS-like synthetic: dense gaussians + a nonlinear decision rule
     rng = np.random.default_rng(7)
@@ -152,6 +440,7 @@ def main() -> None:
 
     mesh = local_mesh()  # all local devices on the data axis (1 chip → 1)
     n_chips = mesh.devices.size
+    EV["config"] = {**EV["config"], "chips": n_chips}   # rebind, no mutate
     model = HistGBT(
         n_trees=rounds,
         max_depth=depth,
@@ -159,85 +448,109 @@ def main() -> None:
         learning_rate=0.1,
         mesh=mesh,
     )
-    def _run_once(warmup_rounds):
-        """One timed fit; returns an evidence dict with per-chunk rates.
+    EV["phase"] = "prepare"      # cuts + H2D + bin: the untimed setup
+    emit()
+    dd = model.make_device_data(X, y)
+    # everything from here runs off the device-resident handle; the host
+    # copies (~1.2 GB at 10M×28) would otherwise sit in RAM to the end
+    del X, y, margin
 
-        ``model.last_chunk_times`` holds in-order (rounds_done, t) arrival
-        timestamps of each chunk's tree fetch (rides the fetch loop that
-        already existed, so recording adds no device traffic).  Per-chunk
-        sec/round is the auditable unit: on a healthy chip all chunks run
-        at the same rate; a degraded tunnel (the round-2 BENCH capture
-        was 68× off) shows up as a worst/best chunk ratio ≫ 1."""
-        model.fit(X, y, warmup_rounds=warmup_rounds)
+    def _run_once(warmup_rounds):
+        """One timed fit on the device-resident handle; returns an
+        evidence dict with per-chunk rates.
+
+        Each chunk arrival fires ``chunk_callback`` → a provisional JSON
+        line, so even a SIGKILL mid-fit leaves the latest rate on
+        stdout.  Per-chunk sec/round is the auditable unit: on a healthy
+        chip all chunks run at the same rate; a degraded tunnel (the
+        round-2 BENCH capture was 68× off) shows up as a worst/best
+        chunk ratio ≫ 1."""
+        EV["chunk_times"] = []
+
+        def cb(done, t_s):
+            EV["chunk_times"].append((done, t_s))
+            emit()
+
+        model.fit_device(dd, warmup_rounds=warmup_rounds,
+                         chunk_callback=cb)
         seconds = model.last_fit_seconds
         out = {
             "seconds": round(seconds, 3),
             "warmup_seconds": round(model.last_warmup_seconds, 3),
+            "rounds_done": rounds,
         }
         out.update(chunk_stats(model.last_chunk_times, rounds, seconds))
+        out["wall_rounds_per_sec"] = round(rounds / seconds / n_chips, 4)
         return out
 
+    EV["phase"] = "warmup+timed"
+    emit()
     try:
         runs = [_run_once(warmup)]
+        EV["runs"] = runs
         if runs[0]["anomaly"]:
             # tunnel-degradation signature: one dispatch orders of
-            # magnitude slower than its siblings.  Re-measure once and
-            # report the better run as official, keeping both as
-            # evidence.  The rerun is a continued fit: the jit cache is
-            # reused but the matrix is re-uploaded and re-binned and the
-            # prior trees replayed for init margins (untimed setup).  If
-            # the rerun itself dies (likely on the very tunnel just
-            # diagnosed as degraded), fall back to run 1's valid data.
-            print("bench: chunk-rate anomaly detected, re-measuring once",
-                  file=sys.stderr, flush=True)
-            try:
-                runs.append(_run_once(1))
-            except Exception as e:  # noqa: BLE001
-                print(f"bench: re-measure failed ({type(e).__name__}: "
-                      f"{e}), keeping first run", file=sys.stderr, flush=True)
-    except Exception as e:  # noqa: BLE001 — bench must always emit its JSON line
-        print(json.dumps({
-            "metric": "histgbt_rounds_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "rounds/s/chip",
-            "vs_baseline": 0.0,
-            "platform": platform,
-            "error": f"{type(e).__name__}: {e}"[:500],
-        }), flush=True)
+            # magnitude slower than its siblings.  Re-measure once ON THE
+            # RESIDENT DATA (fit_device: no re-upload, jit cache warm) —
+            # but only if the budget still fits a full run; otherwise the
+            # median-chunk rate of run 1 is the defensible number.
+            est = runs[0]["seconds"] * 1.5 + 30
+            if deadline - time.time() > est:
+                EV["notes"].append("chunk-rate anomaly: re-measuring once "
+                                   "on resident data")
+                emit()
+                try:
+                    runs.append(_run_once(1))
+                except Exception as e:  # noqa: BLE001
+                    EV["notes"].append(
+                        f"re-measure failed ({type(e).__name__}: {e}), "
+                        "keeping first run")
+            else:
+                EV["notes"].append(
+                    f"chunk-rate anomaly but only {deadline - time.time():.0f}s "
+                    f"budget left (< {est:.0f}s): re-measure skipped")
+    except Exception as e:  # noqa: BLE001 — bench must always emit a line
+        emit(final=True, error=f"{type(e).__name__}: {e}"[:500])
         os._exit(3)
-    official = max(runs, key=lambda r: rounds / r["seconds"])
-    seconds = official["seconds"]
-    rounds_per_sec_per_chip = rounds / seconds / n_chips
 
-    # per-GPU effective rate of the 8×A100 NCCL baseline (mid-band of the
-    # 2-4 rounds/s/chip band; see module docstring + BASELINE.md
-    # comparator section for provenance and uncertainty)
-    target = 2.0
-    out = {
-        "metric": "histgbt_rounds_per_sec_per_chip",
-        "value": round(rounds_per_sec_per_chip, 4),
-        "unit": "rounds/s/chip",
-        "vs_baseline": round(rounds_per_sec_per_chip / target, 4),
-        "vs_baseline_band": [round(rounds_per_sec_per_chip / 4.0, 4),
-                             round(rounds_per_sec_per_chip / 2.0, 4)],
-        "rows": rows,
-        "features": feats,
-        "rounds": rounds,
-        "max_depth": depth,
-        "n_bins": n_bins,
-        "chips": n_chips,
-        "platform": platform,
-        "seconds": seconds,
-        "warmup_seconds": official["warmup_seconds"],
-        "rounds_per_sec_best_chunk": official["rounds_per_sec_best_chunk"],
-        "rounds_per_sec_median_chunk":
-            official["rounds_per_sec_median_chunk"],
-        "anomaly": official["anomaly"],
-        "runs": runs,
-    }
-    out.update(_derived_metrics(rows, feats, depth, n_bins,
-                                seconds / rounds, platform, n_chips))
-    print(json.dumps(out))
+    # Official selection: the FIRST non-anomalous run (never best-of-2 —
+    # an upward-biased headline); if every run is anomalous, report the
+    # best run's MEDIAN-chunk rate (the wall number is corrupted by the
+    # stalled dispatch, the median chunk is not).
+    non_anom = [r for r in runs if not r["anomaly"]]
+    if non_anom:
+        official = dict(non_anom[0])
+        value = official["wall_rounds_per_sec"]
+        EV["value_basis"] = "wall"
+    else:
+        official = dict(max(
+            runs, key=lambda r: r["rounds_per_sec_median_chunk"]))
+        value = official["rounds_per_sec_median_chunk"] / n_chips
+        EV["value_basis"] = "median_chunk"
+    official["value"] = value
+    official.update(_derived_metrics(
+        rows, feats, depth, n_bins,
+        1.0 / (value * n_chips), EV["platform"], n_chips))
+    EV["official"] = official
+    EV["runs"] = runs
+    EV["phase"] = "smoke"
+    emit()           # headline is now on stdout before the smokes run
+
+    # configs 2/4 smoke fields — each budget-gated and non-fatal
+    for name, fn, floor in (("infeed_stall_frac", _smoke_infeed, 75),
+                            ("kvstore_sync_ms", _smoke_kvstore, 60)):
+        if deadline - time.time() < floor:
+            EV["smoke"] = {**EV["smoke"], name: None}    # rebind, no mutate
+            EV["notes"].append(f"{name} skipped: budget")
+            continue
+        try:
+            EV["smoke"] = {**EV["smoke"], name: fn(mesh)}
+        except Exception as e:  # noqa: BLE001
+            EV["smoke"] = {**EV["smoke"], name: None}
+            EV["notes"].append(f"{name} failed: {type(e).__name__}: {e}"[:200])
+
+    EV["phase"] = "done"
+    emit(final=True)
 
 
 if __name__ == "__main__":

@@ -226,7 +226,14 @@ class GBLinear:
         # dense temporary even for BasicRowIter's single whole-data
         # block
         n = row_iter.num_rows
+        counted = False
         if n is None:
+            # NOTE this counting pass iterates row_iter a first time, so
+            # the fill pass below relies on the RowBlockIter rewind
+            # contract (BeforeFirst semantics: iterating again restarts
+            # from the first block).  All in-repo iterators honor it; a
+            # one-shot generator wrapped as an iterator does not.
+            counted = True
             n = sum(b.size for b in row_iter)
         CHECK(n > 0, "fit_iter: iterator yielded no rows")
         X = np.empty((n, F), np.float32)
@@ -239,6 +246,11 @@ class GBLinear:
             y[lo:hi] = b.label
             w[lo:hi] = (b.weight if b.weight is not None else 1.0)
             lo = hi
+        CHECK(not (counted and lo == 0),
+              "fit_iter: iterator yielded rows in the counting pass but "
+              "none in the fill pass — it is not re-iterable (RowBlockIter "
+              "contract: iteration must rewind); pass num_col/num_rows or "
+              "use a rewindable iterator")
         CHECK_EQ(lo, n, "fit_iter: iterator row count inconsistent")
         return self.fit(X, y, weight=w, warmup_rounds=warmup_rounds)
 

@@ -75,20 +75,42 @@ class _EstimatorBase(_SkBase):
         self._extra = dict(extra)
         self._model = None
 
+    #: the constructor's explicit keywords — the ONLY names set_params may
+    #: setattr.  ``hasattr`` would also match methods and properties (a
+    #: set_params(fit=...) must not clobber the bound method, and
+    #: set_params(model=...) must not hit the setter-less property).
+    _PARAM_NAMES = ("booster", "n_estimators", "max_depth", "learning_rate",
+                    "n_bins", "reg_lambda", "reg_alpha", "subsample",
+                    "colsample_bytree", "seed")
+
     # -- sklearn estimator contract -------------------------------------
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
-        out = {k: getattr(self, k) for k in (
-            "booster", "n_estimators", "max_depth", "learning_rate",
-            "n_bins", "reg_lambda", "reg_alpha", "subsample",
-            "colsample_bytree", "seed")}
+        out = {k: getattr(self, k) for k in self._PARAM_NAMES}
         out.update(self._extra)
         return out
 
     def set_params(self, **params: Any) -> "_EstimatorBase":
+        """Known names set attributes; anything else routes to the native
+        booster's kwargs (``_extra``) — GridSearchCV over e.g. ``gamma``
+        works — but is validated EAGERLY against the booster's Parameter
+        schema so a typo raises here (sklearn's contract) instead of
+        deep inside a later fit."""
         for k, v in params.items():
-            if hasattr(self, k) and not k.startswith("_"):
+            if k in self._PARAM_NAMES:
                 setattr(self, k, v)
             else:
+                from dmlc_core_tpu.models.histgbt import HistGBTParam
+                from dmlc_core_tpu.models.linear import GBLinearParam
+                # booster Parameter fields plus the constructor-level
+                # passthroughs (_make forwards _extra to the booster
+                # __init__, which also takes mesh=)
+                known = (set(HistGBTParam.fields())
+                         | set(GBLinearParam.fields()) | {"mesh"})
+                if k not in known:
+                    raise ValueError(
+                        f"Invalid parameter {k!r} for estimator "
+                        f"{type(self).__name__}. Valid parameters: "
+                        f"{sorted(set(self._PARAM_NAMES) | known)}")
                 self._extra[k] = v
         return self
 

@@ -45,6 +45,18 @@ class TestChunkStats:
         assert s["anomaly"] is False
         assert s["rounds_per_sec_best_chunk"] == 2.0
 
+    def test_zero_delta_clamps(self):
+        # coarse timer on a fast local fit: two chunks arrive at the
+        # SAME timestamp — must neither divide by zero nor spuriously
+        # flag anomaly against a normal sibling chunk
+        s = chunk_stats([(25, 1.0), (50, 1.0), (75, 2.0)], 75, 2.0)
+        assert np.isfinite(s["rounds_per_sec_best_chunk"])
+        assert np.isfinite(s["rounds_per_sec_median_chunk"])
+        # the artifact makes normal siblings look 40000x "slower" than
+        # the zero-delta chunk, but nothing is actually slow (40ms/round
+        # < the 50ms/round tunnel-stall floor) — must not flag
+        assert s["anomaly"] is False
+
     def test_threshold_boundary(self):
         # exactly 3.0x is NOT an anomaly; just above is
         at = chunk_stats([(10, 1.0), (20, 4.0)], 20, 4.0)
