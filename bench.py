@@ -26,8 +26,11 @@ Robustness machinery:
     sends SIGTERM first).
   * Config fallback: if the remaining budget can't fit the requested
     rows (datagen + H2D at the measured 12 MB/s tunnel floor + compile +
-    timed fit), rows fall back 10M→4M→2M→1M and the JSON says so
-    (``fallback_from``).  Rounds shrink the same way if needed.
+    timed fit), rows fall back 10M→4M→2M→1M→250k and the JSON says so
+    (``fallback_from``); if rows bottom out, the round count shrinks to
+    the leftover fit window.  ``BENCH_NO_FALLBACK=1`` pins the requested
+    config regardless (self-tests, or a driver that wants exactly one
+    config and accepts watchdog truncation).
   * The anomaly re-measure (tunnel-degradation signature: worst/best
     chunk ratio > 3) reuses the device-resident binned matrix via
     ``HistGBT.fit_device`` — zero re-upload — and is skipped entirely
@@ -285,6 +288,8 @@ def _pick_config(budget_left):
     feats = int(os.environ.get("BENCH_FEATURES", 28))
     rounds = int(os.environ.get("BENCH_ROUNDS", 100))
     requested = rows
+    if os.environ.get("BENCH_NO_FALLBACK"):
+        return rows, feats, rounds
     chain = [requested] + [c for c in (4_000_000, 2_000_000, 1_000_000,
                                        250_000) if c < requested]
     for cand in chain:

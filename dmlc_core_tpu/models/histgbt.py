@@ -586,17 +586,7 @@ class HistGBT:
         K_cls = p.num_class
         if continuing:
             CHECK(self.cuts is not None, "continue-fit without cuts")
-            ndev = int(np.prod([self.mesh.shape[a]
-                                for a in self.mesh.axis_names]))
-            n_pad = (-n) % ndev
-            if n_pad:
-                X = np.concatenate([X, np.zeros((n_pad, F), np.float32)])
-                y = np.concatenate([y, np.zeros(n_pad, np.float32)])
-            mask = np.ones(n + n_pad, np.float32)
-            if weight is not None:
-                mask[:n] = weight
-            if n_pad:
-                mask[n:] = 0.0
+            X, y, mask, n_pad = self._pad_rows(X, y, weight)
             # the warm-start branch needs the row-major f32 upload anyway
             # (margin replay reads it), so it always bins on device
             bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
@@ -616,6 +606,12 @@ class HistGBT:
                 init_margin,
                 mat_sharding if K_cls > 1 else row_sharding)
         else:
+            # a FRESH fit() always re-derives cuts from this X (the
+            # pre-refactor contract): leftovers from an aborted fit or
+            # an earlier fit_device must not silently quantize new data.
+            # Handle-sharing reuse is make_device_data's own contract.
+            if cuts is None:
+                self.cuts = None
             dd = self.make_device_data(X, y, weight=weight, cuts=cuts)
             bins_t, y_d, w_d = dd["bins_t"], dd["y_d"], dd["w_d"]
             preds = self._init_margin_device(dd["n_padded"])
@@ -815,6 +811,24 @@ class HistGBT:
             return coll.allgather
         return None
 
+    def _pad_rows(self, X, y, weight):
+        """Pad rows to a mesh-size multiple and build the weight mask
+        (pad rows weigh 0, so they are invisible to cuts/grads/hists)."""
+        n = len(y)
+        ndev = int(np.prod([self.mesh.shape[a]
+                            for a in self.mesh.axis_names]))
+        n_pad = (-n) % ndev
+        if n_pad:
+            X = np.concatenate([X, np.zeros((n_pad, X.shape[1]),
+                                            np.float32)])
+            y = np.concatenate([y, np.zeros(n_pad, np.float32)])
+        mask = np.ones(n + n_pad, np.float32)
+        if weight is not None:
+            mask[:n] = weight
+        if n_pad:
+            mask[n:] = 0.0
+        return X, y, mask, n_pad
+
     # ------------------------------------------------------------------
     # reusable device-resident training data (DMatrix analogy)
     # ------------------------------------------------------------------
@@ -855,17 +869,7 @@ class HistGBT:
             self.cuts = compute_cuts(
                 X, p.n_bins, weight=weight,
                 allgather_fn=self._maybe_allgather())
-        ndev = int(np.prod([self.mesh.shape[a]
-                            for a in self.mesh.axis_names]))
-        n_pad = (-n) % ndev
-        if n_pad:
-            X = np.concatenate([X, np.zeros((n_pad, F), np.float32)])
-            y = np.concatenate([y, np.zeros(n_pad, np.float32)])
-        mask = np.ones(n + n_pad, np.float32)
-        if weight is not None:
-            mask[:n] = weight
-        if n_pad:
-            mask[n:] = 0.0
+        X, y, mask, n_pad = self._pad_rows(X, y, weight)
 
         row_sharding = NamedSharding(self.mesh, P("data"))
         mat_sharding = NamedSharding(self.mesh, P("data", None))
@@ -979,17 +983,29 @@ class HistGBT:
         Trees produced are the same arrays as :meth:`fit`, so
         :meth:`predict` and checkpointing work unchanged.
 
-        ``cache_device=True`` keeps the binned uint8 pages resident on
-        device instead of re-uploading each page ``depth`` times per tree:
-        much faster when the binned data fits HBM (it is 4× smaller than
-        the raw f32 matrix), while the default keeps device memory bounded
-        by one page — the true out-of-core mode.  Single-worker
-        cache_device runs the in-core chunked engine: identical splits;
-        leaf values carry the histogram-cumsum precision note, and with
-        ``subsample``/``colsample_bytree`` < 1 the *random draws* come
-        from the device PRNG instead of the page loop's numpy PRNG, so
-        the same seed selects a different (equally distributed) sample
-        across the two modes.
+        Device memory contract: bounded by
+        ``DMLC_TPU_EXTERNAL_DEVICE_BUDGET`` (bytes, default 6 GiB).
+        When the whole binned set + per-row state fit the budget (and no
+        sampling is active — see below) the in-core chunked engine runs
+        (identical splits, ~25 rounds per dispatch); otherwise the
+        chunk-streaming engine re-uploads bins per level while per-row
+        state (y/w/preds/g/h/node, 12+12·num_class B/row) stays
+        resident — that row-state floor is the engine's minimum
+        residency, so datasets beyond ``budget/(12+12K)`` rows must
+        shard across workers (PARITY.md §2b records this trade against
+        the r3 per-page mode, whose unbounded-rows promise cost
+        O(pages·depth) host-synced dispatches per round).
+
+        ``cache_device=True`` forces full residency regardless of the
+        budget.  Single-worker cache_device runs the in-core chunked
+        engine: identical splits; leaf values carry the histogram-cumsum
+        precision note, and with ``subsample``/``colsample_bytree`` < 1
+        the *random draws* come from the device PRNG instead of the
+        streaming engine's numpy PRNG, so the same seed selects a
+        different (equally distributed) sample across the two modes.
+        The DEFAULT path never has that ambiguity: with sampling active
+        it always uses the streaming engine's numpy draws, whatever the
+        dataset size.
         """
         from dmlc_core_tpu.ops.quantile import SketchAccumulator
         from dmlc_core_tpu.parallel import collectives as coll
@@ -1002,11 +1018,6 @@ class HistGBT:
               "fit_external: rank:pairwise needs the grouped in-core "
               "layout — use fit(X, y, qid=...)")
         B = p.n_bins
-        depth = p.max_depth
-        n_leaf = 1 << depth
-        half = max(n_leaf >> 1, 1)
-        best_split = _make_best_split(B, p.reg_lambda, p.gamma,
-                                      p.min_child_weight)
 
         # -- pass 1: streaming sketch --------------------------------------
         F = max(num_col or 0, row_iter.num_col)
@@ -1068,141 +1079,32 @@ class HistGBT:
         if cache_device and not distributed:
             return self._fit_external_cached(pages, F, eval_every,
                                              warmup_rounds)
-        obj = self._obj
-
-        def grow_one_tree(col, feat_mask):
-            """One level-wise tree over all pages using class column
-            ``col`` of g/h (None for single-output); leaves pg['node'] at
-            the final leaf assignment."""
-            for pg in pages:
-                pg["node"] = np.zeros(len(pg["y"]), np.int32)
-
-            def gh(pg):
-                if col is None:
-                    return pg["g"], pg["h"]
-                return pg["g"][:, col], pg["h"][:, col]
-
-            feats, thrs, gains = [], [], []
-            prev_hist = None
-            for level in range(depth):
-                # sibling subtraction (same as grow_tree): below the root
-                # build only left children, derive right = parent − left.
-                # Histograms accumulate ON DEVICE across pages and sync as
-                # ONE device allreduce per level (coll.allreduce_device:
-                # XLA AllReduce over ICI/DCN) — the bounded-host-memory
-                # guarantee is unchanged because only O(N·F·B) histogram
-                # state lives on device between pages, never row data.
-                n_nodes = 1 << level
-                n_build = 1 if level == 0 else n_nodes >> 1
-                hist = None
-                for pg in pages:
-                    g_c, h_c = gh(pg)
-                    nd = jnp.asarray(pg["node"])
-                    if level > 0:
-                        nd = jnp.where((nd >= 0) & (nd % 2 == 0),
-                                       nd >> 1, -1)
-                    ph = build_histogram(
-                        jnp.asarray(pg["bins"]), nd,
-                        jnp.asarray(g_c), jnp.asarray(h_c),
-                        n_build, B, p.hist_method, transposed=True)
-                    hist = ph if hist is None else hist + ph
-                if distributed:
-                    hist = coll.allreduce_device(hist)  # cross-worker sync
-                if level > 0:
-                    hist = jnp.stack(
-                        [hist, prev_hist - hist], axis=2).reshape(
-                        2, n_nodes, hist.shape[2], B)
-                prev_hist = hist
-                feat, thr, gn = best_split(hist, feat_mask)
-                feats.append(np.pad(np.asarray(feat), (0, half - n_nodes)))
-                thrs.append(np.pad(np.asarray(thr), (0, half - n_nodes)))
-                gains.append(np.pad(np.asarray(gn), (0, half - n_nodes)))
-                for pg in pages:
-                    pg["node"] = np.asarray(_advance_node(
-                        jnp.asarray(pg["bins"]), jnp.asarray(pg["node"]),
-                        feat, thr))
-            gsum = np.zeros(n_leaf, np.float32)
-            hsum = np.zeros(n_leaf, np.float32)
-            for pg in pages:
-                g_c, h_c = gh(pg)
-                gs, hs = _leaf_sums(jnp.asarray(pg["node"]),
-                                    jnp.asarray(g_c),
-                                    jnp.asarray(h_c), n_leaf)
-                gsum += np.asarray(gs)
-                hsum += np.asarray(hs)
-            if distributed:
-                gsum = coll.allreduce(gsum)
-                hsum = coll.allreduce(hsum)
-            leaf = (-gsum / (hsum + p.reg_lambda) * p.learning_rate
-                    ).astype(np.float32)
-            return np.stack(feats), np.stack(thrs), np.stack(gains), leaf
-
-        t0 = get_time()
-        for r in range(p.n_trees):
-            # per-round sampling, same semantics as fit(): rows drawn per
-            # worker (rank-salted), feature mask identical across workers
-            feat_mask = None
-            if p.colsample_bytree < 1.0:
-                crng = np.random.default_rng([p.seed, r, 1])
-                n_keep = max(1, int(np.ceil(p.colsample_bytree * F)))
-                scores = crng.random(F)
-                feat_mask = jnp.asarray(
-                    scores <= np.sort(scores)[n_keep - 1])
-            rrng = (np.random.default_rng([p.seed, r, 2, coll.rank()])
-                    if p.subsample < 1.0 else None)
-            # grad/hess per page for this round (rows shared across the
-            # round's class trees, like fit())
-            for pg in pages:
-                g, h = obj.grad_hess(jnp.asarray(pg["preds"]),
-                                     jnp.asarray(pg["y"]))
-                w_col = pg["w"] if K_cls == 1 else pg["w"][:, None]
-                pg["g"] = np.asarray(g) * w_col
-                pg["h"] = np.asarray(h) * w_col
-                if rrng is not None:
-                    keep = rrng.random(len(pg["y"])) < p.subsample
-                    k_col = keep if K_cls == 1 else keep[:, None]
-                    pg["g"] = np.where(k_col, pg["g"], 0.0)
-                    pg["h"] = np.where(k_col, pg["h"], 0.0)
-            if K_cls == 1:
-                feats, thrs, gains, leaf = grow_one_tree(None, feat_mask)
-                for pg in pages:
-                    pg["preds"] = pg["preds"] + leaf[pg["node"]]
-                self.trees.append({"feat": feats, "thr": thrs,
-                                   "gain": gains, "leaf": leaf})
-            else:
-                per_class = []
-                for c in range(K_cls):
-                    feats, thrs, gains, leaf = grow_one_tree(c, feat_mask)
-                    for pg in pages:
-                        pg["preds"][:, c] += leaf[pg["node"]]
-                    per_class.append((feats, thrs, gains, leaf))
-                self.trees.append({
-                    "feat": np.stack([t[0] for t in per_class]),
-                    "thr": np.stack([t[1] for t in per_class]),
-                    "gain": np.stack([t[2] for t in per_class]),
-                    "leaf": np.stack([t[3] for t in per_class]),
-                })
-            if eval_every and (r + 1) % eval_every == 0:
-                # mean of per-row losses across ALL pages, then the
-                # objective's finalizer (sqrt for rmse) — a page-wise mean
-                # of metrics would be wrong for non-additive metrics
-                num = sum(float(np.sum(np.asarray(obj.row_loss(
-                    jnp.asarray(pg["preds"]), jnp.asarray(pg["y"])))))
-                    for pg in pages)
-                den = sum(len(pg["y"]) for pg in pages)
-                loss = obj.finalize_mean_loss(num / max(den, 1))
-                LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
-        self.last_fit_seconds = get_time() - t0
-        # the page loop has no dispatch chunks; stale evidence from an
-        # earlier in-core fit must not describe this run
-        self.last_chunk_times = []
-        self.last_warmup_seconds = None
-        # same staleness rule for prediction state: the page loop keeps
-        # margins per page, not as one train-order vector, so a previous
-        # fit's _train_preds must not answer train_margins() for this one
-        self._train_preds = None
-        self._n_real_rows = None
-        return self
+        # auto-residency (VERDICT r3 #3): when the binned data + per-row
+        # state + the cached engine's concat transient fit the device
+        # budget, the streaming loop would be pure dispatch overhead —
+        # route to the in-core engine (identical splits, ~25 rounds per
+        # dispatch).  The budget knob keeps the bounded-memory promise
+        # explicit instead of implicit-per-page.  With sampling active
+        # the chunked engine runs even under budget: the cached engine
+        # draws from the device PRNG, and auto-routing would make the
+        # same seed's sampled rows depend on dataset size vs budget —
+        # the chunked engine reproduces the page-stream numpy draws at
+        # any size.
+        N_total = sum(len(pg["y"]) for pg in pages)
+        from dmlc_core_tpu.base.parameter import get_env
+        budget = get_env("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", 6 << 30, int)
+        row_state = 12 + 12 * K_cls          # y/w/node + preds/g/h per class
+        no_sampling = p.subsample >= 1.0 and p.colsample_bytree >= 1.0
+        if (not distributed and no_sampling
+                and N_total * (2 * F + row_state) <= budget):
+            LOG("INFO", "fit_external: %d rows x %d feats fit the device "
+                "budget (%d MiB; DMLC_TPU_EXTERNAL_DEVICE_BUDGET) - using "
+                "the device-cached engine", N_total, F, budget >> 20)
+            return self._fit_external_cached(pages, F, eval_every,
+                                             warmup_rounds)
+        return self._fit_external_chunked(pages, F, eval_every, distributed,
+                                          budget=budget,
+                                          cache_all=cache_device)
 
     def _fit_external_cached(self, pages, F: int, eval_every: int,
                              warmup_rounds: int = 0) -> "HistGBT":
@@ -1227,8 +1129,15 @@ class HistGBT:
         w = np.concatenate([pg["w"] for pg in pages])
         n = len(y)
         n_pad = (-n) % ndev
-        bins_t = jnp.concatenate(
-            [jnp.asarray(pg["bins"]) for pg in pages], axis=1)
+        if isinstance(pages[0]["bins"], np.ndarray):
+            # host pages (auto-residency route): concatenate on host so
+            # the device sees ONE upload, not one per page — a remote
+            # tunnel charges per-transfer latency ~365 times otherwise
+            bins_t = jnp.asarray(
+                np.concatenate([pg["bins"] for pg in pages], axis=1))
+        else:
+            bins_t = jnp.concatenate(
+                [jnp.asarray(pg["bins"]) for pg in pages], axis=1)
         pages.clear()                     # free the per-page device refs
         if n_pad:
             bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad)))
@@ -1252,6 +1161,293 @@ class HistGBT:
         # recorded real-row count)
         self._train_preds = preds
         self._n_real_rows = n
+        return self
+
+    def _fit_external_chunked(self, pages, F: int, eval_every: int,
+                              distributed: bool, budget: int,
+                              cache_all: bool = False) -> "HistGBT":
+        """Bounded-device-memory boosting over page-stacked chunks.
+
+        Replaces the r3 per-page loop, which paid O(pages·depth)
+        host-SYNCED device round-trips per boosting round (each ~100 ms+
+        through a remote-device tunnel → 658 s/round at 1M rows).  The
+        restructure (VERDICT r3 #3; reference seam: disk_row_iter.h's
+        page-cached training loop, SURVEY.md §2b):
+
+        * pages concatenate into a handful of fixed-shape chunks sized
+          so ONE chunk's bins plus the always-resident per-row state
+          (y/w/preds/g/h/node, 12+12K B/row) fit
+          ``DMLC_TPU_EXTERNAL_DEVICE_BUDGET``; non-resident chunk bins
+          re-upload per level (the out-of-core price), asynchronously;
+        * every per-level product — node histograms, split choice, node
+          routing, leaf sums, margin updates — stays on device; the only
+          host sync is ONE packed fetch per finished tree;
+        * per round: O(depth·chunks) asynchronous dispatches, zero
+          intermediate host syncs (vs O(pages·depth) synced fetches).
+
+        Sampling reproduces the r3 page loop's draws exactly: colsample
+        masks use the same [seed, round, 1] host RNG; subsample keep
+        masks draw per page in stream order from the same
+        [seed, round, 2, rank] RNG before concatenating into chunks.
+
+        Trees/predict/checkpoint contracts match :meth:`fit`.  Like the
+        r3 page loop, ``_train_preds`` is not retained.
+        """
+        from dmlc_core_tpu.parallel import collectives as coll
+
+        p = self.param
+        obj = self._obj
+        B, depth, K_cls = p.n_bins, p.max_depth, p.num_class
+        n_leaf = 1 << depth
+        half = max(n_leaf >> 1, 1)
+        method = p.hist_method
+        best_split = _make_best_split(B, p.reg_lambda, p.gamma,
+                                      p.min_child_weight)
+
+        # -- chunk sizing against the device budget ---------------------
+        page_rows = [len(pg["y"]) for pg in pages]
+        N = sum(page_rows)
+        CHECK(N > 0, "fit_external: no rows")
+        row_state = 12 + 12 * K_cls
+        avail_bins = budget - N * row_state
+        CHECK(avail_bins > F,
+              f"DMLC_TPU_EXTERNAL_DEVICE_BUDGET={budget} cannot hold the "
+              f"always-resident per-row state ({N} rows x {row_state} B "
+              f"= {N * row_state} B) plus one row of bins.  Raise the "
+              f"budget toward the chip's HBM, or shard rows across more "
+              f"workers (each worker's floor is its own shard only).  "
+              f"This floor is the documented trade vs the r3 per-page "
+              f"mode — see fit_external docstring / PARITY.md §2b")
+        rows_per_chunk = min(N, max(int(avail_bins // F), 1))
+        if cache_all:
+            rows_per_chunk = N
+        n_chunks = -(-N // rows_per_chunk)
+        Rc = -(-N // n_chunks)
+        Rc = -(-Rc // 128) * 128            # lane-aligned fixed shape
+        n_chunks = -(-N // Rc)              # rounding may empty the tail
+        resident = n_chunks == 1
+
+        # -- stack pages into chunk arrays, then free the pages ---------
+        # device pages (distributed cache_device: pass 2 binned on
+        # device) concatenate ON device — downloading them per page just
+        # to re-upload would cost a blocked D2H fetch each
+        device_pages = pages and not isinstance(pages[0]["bins"],
+                                                np.ndarray)
+        if device_pages:
+            CHECK(n_chunks == 1,
+                  "device-resident pages require cache_device residency")
+            stacked = jnp.concatenate([pg["bins"] for pg in pages], axis=1)
+            bins_d = [jnp.pad(stacked, ((0, 0), (0, Rc - N)))]
+            bins_h = None
+        else:
+            bins_h = np.zeros((n_chunks, F, Rc), np.uint8)
+        y_h = np.zeros((n_chunks, Rc), np.float32)
+        w_h = np.zeros((n_chunks, Rc), np.float32)   # pad rows weigh 0
+        pos = 0
+        for pg in pages:
+            r = len(pg["y"])
+            done = 0
+            while done < r:
+                c, off = divmod(pos, Rc)
+                take = min(r - done, Rc - off)
+                if bins_h is not None:
+                    bins_h[c, :, off:off + take] = \
+                        pg["bins"][:, done:done + take]
+                y_h[c, off:off + take] = pg["y"][done:done + take]
+                w_h[c, off:off + take] = pg["w"][done:done + take]
+                done += take
+                pos += take
+        n_valid = [max(0, min(Rc, N - c * Rc)) for c in range(n_chunks)]
+        pages.clear()
+
+        # -- device-resident per-row state ------------------------------
+        y_d = [jnp.asarray(y_h[c]) for c in range(n_chunks)]
+        w_d = [jnp.asarray(w_h[c]) for c in range(n_chunks)]
+        mshape = (Rc, K_cls) if K_cls > 1 else (Rc,)
+        init_margin = jax.jit(
+            lambda: jnp.full(mshape, p.base_score, jnp.float32))
+        preds_d = [init_margin() for _ in range(n_chunks)]
+        zeros_node = jax.jit(lambda: jnp.zeros(Rc, jnp.int32))()
+        if not device_pages:
+            bins_d = ([jnp.asarray(bins_h[c]) for c in range(n_chunks)]
+                      if resident else None)
+
+        def chunk_bins(c):
+            return bins_d[c] if bins_d is not None else jnp.asarray(bins_h[c])
+
+        # -- jitted round pieces (fixed Rc → one compile each) -----------
+        @jax.jit
+        def gh_fn(preds, y, wk):
+            g, h = obj.grad_hess(preds, y)
+            w_col = wk if K_cls == 1 else wk[:, None]
+            return g * w_col, h * w_col
+
+        @partial(jax.jit, static_argnums=(4, 5))
+        def hist_lvl(bins, node, g, h, level, col):
+            g_c = g if col is None else g[:, col]
+            h_c = h if col is None else h[:, col]
+            n_nodes = 1 << level
+            n_build = 1 if level == 0 else n_nodes >> 1
+            nd = node
+            if level > 0:
+                nd = jnp.where((nd >= 0) & (nd % 2 == 0), nd >> 1, -1)
+            return build_histogram(bins, nd, g_c, h_c, n_build, B,
+                                   method, transposed=True)
+
+        @partial(jax.jit, static_argnums=(2,))
+        def sib_stack(hist, prev_hist, level):
+            n_nodes = 1 << level
+            return jnp.stack([hist, prev_hist - hist], axis=2).reshape(
+                2, n_nodes, hist.shape[2], B)
+
+        split_fn = jax.jit(best_split)
+
+        @partial(jax.jit, static_argnums=(3,))
+        def upd_preds(preds, node, leaf, col):
+            gain = leaf[jnp.clip(node, 0, n_leaf - 1)]
+            if col is None:
+                return preds + gain
+            return preds.at[:, col].add(gain)
+
+        @jax.jit
+        def leaf_calc(gsum, hsum):
+            return (-gsum / (hsum + p.reg_lambda)
+                    * p.learning_rate).astype(jnp.float32)
+
+        @jax.jit
+        def pack_tree(feats, thrs, gains, leaf):
+            """One flat f32 array per tree → ONE host fetch (feat/thr are
+            small ints, exact in f32)."""
+            fp = jnp.concatenate([jnp.pad(f, (0, half - f.shape[0]))
+                                  for f in feats]).astype(jnp.float32)
+            tp = jnp.concatenate([jnp.pad(t, (0, half - t.shape[0]))
+                                  for t in thrs]).astype(jnp.float32)
+            gp = jnp.concatenate([jnp.pad(g, (0, half - g.shape[0]))
+                                  for g in gains])
+            return jnp.concatenate([fp, tp, gp, leaf])
+
+        @partial(jax.jit, static_argnums=(2,))
+        def eval_loss(preds, y, nv):
+            return jnp.sum(obj.row_loss(preds[:nv], y[:nv]))
+
+        def grow_one_tree(col, feat_mask, g_d, h_d):
+            """One level-wise tree; returns device (feats, thrs, gains,
+            leaf) and the per-chunk leaf assignments — nothing fetched."""
+            node = [zeros_node for _ in range(n_chunks)]
+            feats, thrs, gains = [], [], []
+            prev_hist = None
+            for level in range(depth):
+                hist = None
+                for c in range(n_chunks):
+                    ph = hist_lvl(chunk_bins(c), node[c], g_d[c], h_d[c],
+                                  level, col)
+                    hist = ph if hist is None else hist + ph
+                if distributed:
+                    hist = coll.allreduce_device(hist)
+                if level > 0:
+                    hist = sib_stack(hist, prev_hist, level)
+                prev_hist = hist
+                feat, thr, gain = split_fn(hist, feat_mask)
+                feats.append(feat)
+                thrs.append(thr)
+                gains.append(gain)
+                for c in range(n_chunks):
+                    node[c] = _advance_node(chunk_bins(c), node[c],
+                                            feat, thr)
+            gsum = hsum = None
+            for c in range(n_chunks):
+                g_c = g_d[c] if col is None else g_d[c][:, col]
+                h_c = h_d[c] if col is None else h_d[c][:, col]
+                gs, hs = _leaf_sums(node[c], g_c, h_c, n_leaf)
+                gsum = gs if gsum is None else gsum + gs
+                hsum = hs if hsum is None else hsum + hs
+            if distributed:
+                gsum = coll.allreduce_device(gsum)
+                hsum = coll.allreduce_device(hsum)
+            return feats, thrs, gains, leaf_calc(gsum, hsum), node
+
+        def unpack_tree(flat):
+            fl = np.asarray(flat)           # the ONE per-tree host sync
+            d = depth * half
+            feats = fl[:d].astype(np.int32).reshape(depth, half)
+            thrs = fl[d:2 * d].astype(np.int32).reshape(depth, half)
+            gains = fl[2 * d:3 * d].reshape(depth, half)
+            leaf = fl[3 * d:]
+            return feats, thrs, gains, leaf
+
+        t0 = get_time()
+        for r in range(p.n_trees):
+            feat_mask = None                 # same RNG as the r3 page loop
+            if p.colsample_bytree < 1.0:
+                crng = np.random.default_rng([p.seed, r, 1])
+                n_keep = max(1, int(np.ceil(p.colsample_bytree * F)))
+                scores = crng.random(F)
+                feat_mask = jnp.asarray(
+                    scores <= np.sort(scores)[n_keep - 1])
+            if p.subsample < 1.0:
+                rrng = np.random.default_rng([p.seed, r, 2, coll.rank()])
+                keep = np.zeros((n_chunks, Rc), np.float32)
+                kpos = 0
+                for pr in page_rows:         # per page, in stream order
+                    draws = (rrng.random(pr) < p.subsample).astype(
+                        np.float32)
+                    done = 0
+                    while done < pr:
+                        c, off = divmod(kpos, Rc)
+                        take = min(pr - done, Rc - off)
+                        keep[c, off:off + take] = draws[done:done + take]
+                        done += take
+                        kpos += take
+                wk = [jnp.asarray(w_h[c] * keep[c]) for c in range(n_chunks)]
+            else:
+                wk = w_d
+            g_d, h_d = [], []
+            for c in range(n_chunks):
+                g, h = gh_fn(preds_d[c], y_d[c], wk[c])
+                g_d.append(g)
+                h_d.append(h)
+            if K_cls == 1:
+                feats, thrs, gains, leaf, node = grow_one_tree(
+                    None, feat_mask, g_d, h_d)
+                for c in range(n_chunks):
+                    preds_d[c] = upd_preds(preds_d[c], node[c], leaf, None)
+                f, t, gn, lf = unpack_tree(pack_tree(feats, thrs, gains,
+                                                     leaf))
+                self.trees.append({"feat": f, "thr": t, "gain": gn,
+                                   "leaf": lf})
+            else:
+                per_class = []
+                for col in range(K_cls):
+                    feats, thrs, gains, leaf, node = grow_one_tree(
+                        col, feat_mask, g_d, h_d)
+                    for c in range(n_chunks):
+                        preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
+                                               col)
+                    per_class.append(unpack_tree(
+                        pack_tree(feats, thrs, gains, leaf)))
+                self.trees.append({
+                    "feat": np.stack([t[0] for t in per_class]),
+                    "thr": np.stack([t[1] for t in per_class]),
+                    "gain": np.stack([t[2] for t in per_class]),
+                    "leaf": np.stack([t[3] for t in per_class]),
+                })
+            if eval_every and (r + 1) % eval_every == 0:
+                # mean of per-row losses across all chunks (pad rows
+                # excluded by the static n_valid slice), then the
+                # objective's finalizer — a chunk-wise mean of metrics
+                # would be wrong for non-additive metrics
+                num = sum(float(eval_loss(preds_d[c], y_d[c], n_valid[c]))
+                          for c in range(n_chunks) if n_valid[c])
+                loss = obj.finalize_mean_loss(num / max(N, 1))
+                LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
+        self.last_fit_seconds = get_time() - t0
+        # the chunk loop has no dispatch-chunk evidence; stale numbers
+        # from an earlier in-core fit must not describe this run
+        self.last_chunk_times = []
+        self.last_warmup_seconds = None
+        # margins live padded per chunk, not as one train-order vector
+        self._train_preds = None
+        self._n_real_rows = None
         return self
 
     # ------------------------------------------------------------------

@@ -108,8 +108,12 @@ class TestBenchSurvivesKill:
 
     def test_budget_exhaustion_flushes_and_exits_zero(self):
         # budget expires mid-fit; the watchdog thread must flush and
-        # exit 0 well before the outer 240s cap
-        proc = _spawn(BENCH_ROUNDS=2000, BENCH_TIME_BUDGET=30)
+        # exit 0 well before the outer 240s cap.  BENCH_NO_FALLBACK pins
+        # the 2000-round config — otherwise _pick_config would shrink
+        # rounds to fit the budget and a fast machine could finish
+        # cleanly before the watchdog fires
+        proc = _spawn(BENCH_ROUNDS=2000, BENCH_TIME_BUDGET=30,
+                      BENCH_NO_FALLBACK=1)
         try:
             out, _ = proc.communicate(timeout=240)
         except subprocess.TimeoutExpired:

@@ -268,6 +268,99 @@ class TestFitExternal:
             assert len(m.trees) == 3
 
 
+class TestChunkedStreamingEngine:
+    """The over-budget path: pages stack into >1 fixed-shape chunks and
+    stream per level (VERDICT r3 #3's O(depth·chunks) restructure).
+    Small datasets normally auto-route to the cached engine, so these
+    tests shrink DMLC_TPU_EXTERNAL_DEVICE_BUDGET until residency is
+    impossible and the streaming engine must run."""
+
+    def test_forced_chunked_matches_in_core(self, monkeypatch):
+        X, y = _synth(4_000, 6, seed=3)
+        # row state 4000·24 B; bins 4000·6 B — 110 kB forces ≥2 chunks
+        monkeypatch.setenv("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", "110000")
+        with TemporaryDirectory() as tmp:
+            data = os.path.join(tmp.path, "train.libsvm")
+            cache = os.path.join(tmp.path, "cache")
+            _write_libsvm(data, X, y)
+            common = dict(n_trees=5, max_depth=3, n_bins=32,
+                          hist_method="segment")
+            incore = HistGBT(**common)
+            incore.fit(X, y)
+            it = RowBlockIter.create(f"{data}#{cache}", 0, 1, "libsvm")
+            ext = HistGBT(**common)
+            ext.fit_external(it, cuts=incore.cuts)
+            it.close()
+            for t_in, t_ext in zip(incore.trees, ext.trees):
+                np.testing.assert_array_equal(t_in["feat"], t_ext["feat"])
+                np.testing.assert_array_equal(t_in["thr"], t_ext["thr"])
+                np.testing.assert_allclose(t_in["leaf"], t_ext["leaf"],
+                                           rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(incore.predict(X[:256]),
+                                       ext.predict(X[:256]),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_forced_chunked_multiclass(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(3_000, 5)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32) + (
+            X[:, 2] > 0.8).astype(np.float32)
+        # row state 3000·48 B = 144000; 150000 leaves 6000 B for bins →
+        # 1200 rows/chunk → 3 chunks: genuinely multi-chunk multiclass
+        monkeypatch.setenv("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", "150000")
+        with TemporaryDirectory() as tmp:
+            data = os.path.join(tmp.path, "t.libsvm")
+            _write_libsvm(data, X, y)
+            common = dict(n_trees=4, max_depth=3, n_bins=16,
+                          num_class=3, objective="multi:softmax",
+                          hist_method="segment")
+            incore = HistGBT(**common)
+            incore.fit(X, y)
+            it = RowBlockIter.create(data, 0, 1, "libsvm")
+            ext = HistGBT(**common)
+            ext.fit_external(it, num_col=5, cuts=incore.cuts)
+            it.close()
+            for t_in, t_ext in zip(incore.trees, ext.trees):
+                np.testing.assert_array_equal(t_in["feat"], t_ext["feat"])
+                np.testing.assert_array_equal(t_in["thr"], t_ext["thr"])
+                np.testing.assert_allclose(t_in["leaf"], t_ext["leaf"],
+                                           rtol=2e-4, atol=2e-5)
+            assert (ext.predict(X) == incore.predict(X)).mean() > 0.99
+
+    def test_forced_chunked_sampling_and_eval(self, monkeypatch, caplog):
+        """Sampling + eval_every run through the streaming engine; draws
+        are deterministic (two runs → identical trees) and training
+        still learns."""
+        X, y = _synth(3_000, 4, seed=9)
+        # row state 3000·24 B = 72000; 80000 leaves 8000 B for bins →
+        # 2000 rows/chunk → 2 chunks: the per-page keep-mask scatter
+        # must spill across a chunk boundary
+        monkeypatch.setenv("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", "80000")
+        runs = []
+        for _ in range(2):
+            with TemporaryDirectory() as tmp:
+                data = os.path.join(tmp.path, "t.libsvm")
+                _write_libsvm(data, X, y)
+                it = RowBlockIter.create(data, 0, 1, "libsvm")
+                m = HistGBT(n_trees=6, max_depth=3, n_bins=16, seed=7,
+                            subsample=0.8, colsample_bytree=0.75,
+                            hist_method="segment")
+                m.fit_external(it, eval_every=3)
+                it.close()
+                runs.append(m)
+        for ta, tb in zip(runs[0].trees, runs[1].trees):
+            np.testing.assert_array_equal(ta["feat"], tb["feat"])
+            np.testing.assert_array_equal(ta["thr"], tb["thr"])
+            np.testing.assert_allclose(ta["leaf"], tb["leaf"],
+                                       rtol=1e-5, atol=1e-6)
+        margins = runs[0].predict(X, output_margin=True)
+        prob = 1 / (1 + np.exp(-margins))
+        eps = 1e-7
+        ll = -np.mean(y * np.log(prob + eps)
+                      + (1 - y) * np.log(1 - prob + eps))
+        assert ll < 0.55, ll
+
+
 def test_external_memory_multiclass(tmp_path):
     """fit_external with multi:softmax must match in-core fit() given the
     same cuts (same data, single worker, deterministic splits)."""

