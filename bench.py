@@ -269,15 +269,32 @@ def chunk_stats(chunk_times, total_rounds, total_seconds):
 
 
 def _setup_estimate(rows, feats, rounds):
-    """Pessimistic seconds to reach the end of the timed fit: datagen on
-    one core + f32 H2D at the measured tunnel floor + compile/warmup +
+    """Pessimistic seconds to reach the end of the timed fit: datagen +
+    host cuts/binning on one core + the uint8 H2D at the measured tunnel
+    FLOOR (bandwidth swings 5-17 MB/s between runs — r4 measured the
+    same 200 MB at 5.4 and 11.1 MB/s minutes apart) + compile/warmup +
     the fit itself at the measured per-row rate (8 r/s at 10M)."""
-    bytes_x = rows * feats * 4
-    datagen = bytes_x / 60e6
-    upload = bytes_x / _TUNNEL_MBPS + rows * 8 / _TUNNEL_MBPS
+    bytes_up = rows * feats + rows * 8          # uint8 bins + y/mask f32
+    datagen = rows * feats * 4 / 60e6
+    host_prep = rows * feats * 4 / 40e6         # cuts + searchsorted bin
+    upload = bytes_up / _TUNNEL_MBPS
     compile_warm = 75.0
     spr = max(rows * 1.25e-8, 0.005)
-    return datagen + upload + compile_warm + rounds * spr
+    return datagen + host_prep + upload + compile_warm + rounds * spr
+
+
+def _host_cuts(X, n_bins, sample=2_000_000):
+    """Sampled per-feature quantile cuts on the HOST (4 s at 10M×28).
+
+    The r3 bench computed cuts on device, which shipped the f32 matrix
+    through the tunnel TWICE (once for the quantile sort, once to bin) —
+    439 s of a 497 s run on a slow-tunnel day (r4 instrumented
+    breakdown).  Together with DMLC_TPU_BIN_BACKEND=cpu the setup now
+    uploads only the uint8 bin matrix: 8× fewer bytes."""
+    step = max(1, len(X) // sample)
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return np.ascontiguousarray(
+        np.quantile(X[::step], qs, axis=0).T.astype(np.float32))
 
 
 def _pick_config(budget_left):
@@ -453,9 +470,13 @@ def main() -> None:
         learning_rate=0.1,
         mesh=mesh,
     )
-    EV["phase"] = "prepare"      # cuts + H2D + bin: the untimed setup
+    EV["phase"] = "prepare"      # cuts + bin on host, uint8 H2D: setup
     emit()
-    dd = model.make_device_data(X, y)
+    # host-side cuts + binning (see _host_cuts): only the uint8 bin
+    # matrix crosses the tunnel.  setdefault so an operator can still
+    # force the device path with DMLC_TPU_BIN_BACKEND="".
+    os.environ.setdefault("DMLC_TPU_BIN_BACKEND", "cpu")
+    dd = model.make_device_data(X, y, cuts=_host_cuts(X, n_bins))
     # everything from here runs off the device-resident handle; the host
     # copies (~1.2 GB at 10M×28) would otherwise sit in RAM to the end
     del X, y, margin

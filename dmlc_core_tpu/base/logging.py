@@ -146,31 +146,38 @@ def CHECK(cond: Any, msg: str = "") -> None:
 
 
 def CHECK_EQ(lhs: Any, rhs: Any, msg: str = "") -> None:
+    """Fatal unless ``lhs == rhs`` (reference ``CHECK_EQ``); the failure
+    message prints both operands."""
     if not (lhs == rhs):
         _fail("==", lhs, rhs, msg)
 
 
 def CHECK_NE(lhs: Any, rhs: Any, msg: str = "") -> None:
+    """Fatal unless ``lhs != rhs`` (reference ``CHECK_NE``)."""
     if not (lhs != rhs):
         _fail("!=", lhs, rhs, msg)
 
 
 def CHECK_LT(lhs: Any, rhs: Any, msg: str = "") -> None:
+    """Fatal unless ``lhs < rhs`` (reference ``CHECK_LT``)."""
     if not (lhs < rhs):
         _fail("<", lhs, rhs, msg)
 
 
 def CHECK_GT(lhs: Any, rhs: Any, msg: str = "") -> None:
+    """Fatal unless ``lhs > rhs`` (reference ``CHECK_GT``)."""
     if not (lhs > rhs):
         _fail(">", lhs, rhs, msg)
 
 
 def CHECK_LE(lhs: Any, rhs: Any, msg: str = "") -> None:
+    """Fatal unless ``lhs <= rhs`` (reference ``CHECK_LE``)."""
     if not (lhs <= rhs):
         _fail("<=", lhs, rhs, msg)
 
 
 def CHECK_GE(lhs: Any, rhs: Any, msg: str = "") -> None:
+    """Fatal unless ``lhs >= rhs`` (reference ``CHECK_GE``)."""
     if not (lhs >= rhs):
         _fail(">=", lhs, rhs, msg)
 

@@ -89,6 +89,8 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def native_queue_available() -> bool:
+    """True when the native lock-free MPMC queue (cpp/mpmc_queue.cc) is
+    built and loadable; consumers fall back to the Python queue otherwise."""
     return _load() is not None
 
 

@@ -54,10 +54,14 @@ def encode_lrec(cflag: int, length: int) -> int:
 
 
 def decode_flag(lrec: int) -> int:
+    """Continuation flag (upper 3 bits) of a RecordIO length word
+    (reference recordio.h ``DecodeFlag``)."""
     return (lrec >> 29) & 7
 
 
 def decode_length(lrec: int) -> int:
+    """Payload byte length (lower 29 bits) of a RecordIO length word
+    (reference recordio.h ``DecodeLength``)."""
     return lrec & _MAX_LEN
 
 

@@ -39,6 +39,8 @@ __all__ = [
 
 def _make_scalar(fmt: str):
     packer = struct.Struct("<" + fmt)
+    tname = {"I": "uint32", "Q": "uint64", "i": "int32", "q": "int64",
+             "f": "float32", "d": "float64", "?": "bool"}[fmt]
 
     def write(stream: Stream, value) -> None:
         stream.write(packer.pack(value))
@@ -46,6 +48,12 @@ def _make_scalar(fmt: str):
     def read(stream: Stream):
         return packer.unpack(stream.read_exact(packer.size))[0]
 
+    write.__doc__ = (f"Write one little-endian ``{tname}`` to ``stream`` "
+                     f"(canonical wire scalar; reference serializer.h).")
+    read.__doc__ = (f"Read one little-endian ``{tname}`` from ``stream`` "
+                    f"(canonical wire scalar; reference serializer.h).")
+    write.__name__ = f"write_{tname}"
+    read.__name__ = f"read_{tname}"
     return write, read
 
 
@@ -65,15 +73,20 @@ def write_bytes(stream: Stream, data: bytes) -> None:
 
 
 def read_bytes(stream: Stream) -> bytes:
+    """Read a uint64-length-prefixed byte string (inverse of
+    :func:`write_bytes`)."""
     n = read_uint64(stream)
     return stream.read_exact(n)
 
 
 def write_string(stream: Stream, s: str) -> None:
+    """Write ``s`` UTF-8 encoded with uint64 length prefix (reference
+    string framing)."""
     write_bytes(stream, s.encode("utf-8"))
 
 
 def read_string(stream: Stream) -> str:
+    """Read a UTF-8 string written by :func:`write_string`."""
     return read_bytes(stream).decode("utf-8")
 
 
@@ -88,6 +101,8 @@ def write_vector(stream: Stream, seq: Sequence[Any],
 
 
 def read_vector(stream: Stream, read_elem: Callable[[Stream], Any]) -> List[Any]:
+    """Read a uint64-count-prefixed sequence, decoding each element with
+    ``read_elem`` (inverse of :func:`write_vector`)."""
     n = read_uint64(stream)
     return [read_elem(stream) for _ in range(n)]
 
@@ -111,6 +126,8 @@ def write_ndarray(stream: Stream, arr: np.ndarray) -> None:
 
 
 def read_ndarray(stream: Stream) -> np.ndarray:
+    """Read a numpy array written by :func:`write_ndarray` (dtype string +
+    shape + raw little-endian buffer)."""
     dtype = np.dtype(read_string(stream))
     ndim = read_uint32(stream)
     shape = tuple(read_uint64(stream) for _ in range(ndim))
@@ -176,6 +193,10 @@ def write_obj(stream: Stream, obj: Any) -> None:
 
 
 def read_obj(stream: Stream, serializable_factory: Callable[[], Any] | None = None) -> Any:
+    """Read one object written by :func:`write_obj` — scalars, strings,
+    bytes, numpy arrays, and nested list/tuple/dict/set containers;
+    ``serializable_factory`` constructs application objects that
+    implement the Serializable protocol."""
     tag = stream.read_exact(1)[0]
     if tag == _TAG_NONE:
         return None

@@ -232,24 +232,31 @@ class _PairwiseRank(_ObjectiveBase):
         return losses.sum() / jnp.maximum(counts.sum(), 1)
 
 
-def _host_bin_device():
-    """Device of the DMLC_TPU_BIN_BACKEND override (None = bin where the
-    data lives).  Through a remote-device tunnel, host binning uploads
-    the 4×-smaller uint8 matrix instead of f32 features; see the call
-    sites for the measured trade-offs."""
+def _host_bin_requested() -> bool:
+    """True when DMLC_TPU_BIN_BACKEND requests host-side binning (any
+    non-empty value; False = bin where the data lives).  Through a
+    remote-device tunnel, host binning uploads the 4×-smaller uint8
+    matrix instead of f32 features; see the call sites for the measured
+    trade-offs."""
     from dmlc_core_tpu.base.parameter import get_env
 
-    backend = get_env("DMLC_TPU_BIN_BACKEND", "", str)
-    return jax.local_devices(backend=backend)[0] if backend else None
+    return bool(get_env("DMLC_TPU_BIN_BACKEND", "", str))
 
 
-def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray, dev) -> np.ndarray:
-    """Bin ``X`` on ``dev`` and return the FEATURE-major uint8 matrix as
-    one host array (transpose inside the jax call — a NumPy .T +
-    ascontiguousarray would hold a second full copy)."""
-    with jax.default_device(dev):
-        return np.asarray(apply_bins(jnp.asarray(X),
-                                     jnp.asarray(cuts_np)).T)
+def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray) -> np.ndarray:
+    """Bin ``X`` on the HOST and return the FEATURE-major bin matrix.
+
+    Pure numpy searchsorted, feature by feature — same semantics as
+    :func:`ops.quantile.apply_bins` (bin = #cuts ≤ value, side='right';
+    uint8 when bins fit).  Measured 22 s for 10M×28 on one core (r4),
+    replacing the earlier jax-CPU-backend detour, and the per-feature
+    loop never materializes a second full-matrix copy."""
+    dtype = np.uint8 if cuts_np.shape[1] < 256 else np.int32
+    out = np.empty((X.shape[1], len(X)), dtype)
+    for j in range(X.shape[1]):
+        out[j] = np.searchsorted(cuts_np[j], X[:, j],
+                                 side="right").astype(dtype)
+    return out
 
 
 def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
@@ -873,7 +880,7 @@ class HistGBT:
 
         row_sharding = NamedSharding(self.mesh, P("data"))
         mat_sharding = NamedSharding(self.mesh, P("data", None))
-        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_device) uploads the
+        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_requested) uploads the
         # uint8 result — 4× less transfer than shipping f32 X to bin on
         # device.  Measured trade-off at 2M×28 through the 12-17 MB/s
         # axon tunnel on a 1-core host: device path 26.7 s setup vs
@@ -881,10 +888,9 @@ class HistGBT:
         # outweighs the transfer saving HERE, so the knob stays opt-in
         # for hosts with cores or slower links; default (unset) is the
         # device path.
-        bin_dev = _host_bin_device()
-        if bin_dev is not None:
+        if _host_bin_requested():
             bins_t = jax.device_put(
-                _host_bin_t(X, np.asarray(self.cuts), bin_dev),
+                _host_bin_t(X, np.asarray(self.cuts)),
                 NamedSharding(self.mesh, P(None, "data")))
         else:
             bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
@@ -1043,18 +1049,18 @@ class HistGBT:
         # -- pass 2: bin pages (uint8, FEATURE-major like fit()) -----------
         K_cls = p.num_class
         pages: List[Dict[str, Any]] = []   # "bins" is a jax.Array when cache_device
-        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_device) bins pages on
+        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_requested) bins pages on
         # the host backend and uploads nothing per page: through a
         # remote-device tunnel, 365 per-page f32 uploads cost seconds
         # each, while the cached path re-uploads the 4x-smaller uint8
         # matrix ONCE at concat time.  On a locally attached chip leave
         # it unset (device binning).
-        bin_dev = _host_bin_device()
-        cuts_for_bin = np.asarray(self.cuts) if bin_dev is not None else None
+        host_bin = _host_bin_requested()
+        cuts_for_bin = np.asarray(self.cuts) if host_bin else None
         for block in row_iter:
             X = block.to_dense(F)
-            if bin_dev is not None:
-                bins = _host_bin_t(X, cuts_for_bin, bin_dev)
+            if host_bin:
+                bins = _host_bin_t(X, cuts_for_bin)
             else:
                 bins = apply_bins(jnp.asarray(X), self.cuts).T  # [F, rows]
                 if not cache_device:
@@ -1066,8 +1072,6 @@ class HistGBT:
                 "bins": bins,
                 "y": np.asarray(block.label, np.float32),
                 "w": w,
-                "preds": np.full(self._margin_shape(len(X)), p.base_score,
-                                 np.float32),
             })
         if K_cls > 1:
             for pg in pages:
@@ -1209,18 +1213,23 @@ class HistGBT:
         N = sum(page_rows)
         CHECK(N > 0, "fit_external: no rows")
         row_state = 12 + 12 * K_cls
-        avail_bins = budget - N * row_state
-        CHECK(avail_bins > F,
-              f"DMLC_TPU_EXTERNAL_DEVICE_BUDGET={budget} cannot hold the "
-              f"always-resident per-row state ({N} rows x {row_state} B "
-              f"= {N * row_state} B) plus one row of bins.  Raise the "
-              f"budget toward the chip's HBM, or shard rows across more "
-              f"workers (each worker's floor is its own shard only).  "
-              f"This floor is the documented trade vs the r3 per-page "
-              f"mode — see fit_external docstring / PARITY.md §2b")
-        rows_per_chunk = min(N, max(int(avail_bins // F), 1))
         if cache_all:
+            # cache_device=True overrides the budget by contract (the
+            # budget CHECK must not kill a forced-residency request)
             rows_per_chunk = N
+        else:
+            avail_bins = budget - N * row_state
+            CHECK(avail_bins > F,
+                  f"DMLC_TPU_EXTERNAL_DEVICE_BUDGET={budget} cannot hold "
+                  f"the always-resident per-row state ({N} rows x "
+                  f"{row_state} B = {N * row_state} B) plus one row of "
+                  f"bins.  Raise the budget toward the chip's HBM, shard "
+                  f"rows across more workers (each worker's floor is its "
+                  f"own shard only), or force residency with "
+                  f"cache_device=True.  This floor is the documented "
+                  f"trade vs the r3 per-page mode — see fit_external "
+                  f"docstring / PARITY.md §2b")
+            rows_per_chunk = min(N, max(int(avail_bins // F), 1))
         n_chunks = -(-N // rows_per_chunk)
         Rc = -(-N // n_chunks)
         Rc = -(-Rc // 128) * 128            # lane-aligned fixed shape
@@ -1282,8 +1291,14 @@ class HistGBT:
             w_col = wk if K_cls == 1 else wk[:, None]
             return g * w_col, h * w_col
 
-        @partial(jax.jit, static_argnums=(4, 5))
-        def hist_lvl(bins, node, g, h, level, col):
+        @partial(jax.jit, static_argnums=(6, 7))
+        def adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev, level, col):
+            """Advance nodes one level (using the PREVIOUS level's split,
+            level 0 skips it) then build this level's histogram — fused
+            so a streamed chunk's bins upload is consumed ONCE per level,
+            not once for hist and again for advance."""
+            if level > 0:
+                node = _advance_node(bins, node, feat_prev, thr_prev)
             g_c = g if col is None else g[:, col]
             h_c = h if col is None else h[:, col]
             n_nodes = 1 << level
@@ -1291,8 +1306,16 @@ class HistGBT:
             nd = node
             if level > 0:
                 nd = jnp.where((nd >= 0) & (nd % 2 == 0), nd >> 1, -1)
-            return build_histogram(bins, nd, g_c, h_c, n_build, B,
-                                   method, transposed=True)
+            return node, build_histogram(bins, nd, g_c, h_c, n_build, B,
+                                         method, transposed=True)
+
+        @partial(jax.jit, static_argnums=(6,))
+        def final_adv_leaf(bins, node, g_c, h_c, feat, thr, _n_leaf):
+            """Last advance (deepest split) fused with the leaf g/h sums
+            — again one bins consumption for the level."""
+            node = _advance_node(bins, node, feat, thr)
+            gs, hs = _leaf_sums(node, g_c, h_c, _n_leaf)
+            return node, gs, hs
 
         @partial(jax.jit, static_argnums=(2,))
         def sib_stack(hist, prev_hist, level):
@@ -1332,15 +1355,21 @@ class HistGBT:
 
         def grow_one_tree(col, feat_mask, g_d, h_d):
             """One level-wise tree; returns device (feats, thrs, gains,
-            leaf) and the per-chunk leaf assignments — nothing fetched."""
+            leaf) and the per-chunk leaf assignments — nothing fetched.
+            Each level consumes every chunk's bins exactly once
+            (advance-from-previous-split fused with the histogram build;
+            the deepest advance fused with the leaf sums), so a streamed
+            chunk pays depth+1 uploads per tree."""
             node = [zeros_node for _ in range(n_chunks)]
             feats, thrs, gains = [], [], []
             prev_hist = None
+            feat = thr = None
             for level in range(depth):
                 hist = None
                 for c in range(n_chunks):
-                    ph = hist_lvl(chunk_bins(c), node[c], g_d[c], h_d[c],
-                                  level, col)
+                    node[c], ph = adv_hist_lvl(
+                        chunk_bins(c), node[c], g_d[c], h_d[c],
+                        feat, thr, level, col)
                     hist = ph if hist is None else hist + ph
                 if distributed:
                     hist = coll.allreduce_device(hist)
@@ -1351,14 +1380,12 @@ class HistGBT:
                 feats.append(feat)
                 thrs.append(thr)
                 gains.append(gain)
-                for c in range(n_chunks):
-                    node[c] = _advance_node(chunk_bins(c), node[c],
-                                            feat, thr)
             gsum = hsum = None
             for c in range(n_chunks):
                 g_c = g_d[c] if col is None else g_d[c][:, col]
                 h_c = h_d[c] if col is None else h_d[c][:, col]
-                gs, hs = _leaf_sums(node[c], g_c, h_c, n_leaf)
+                node[c], gs, hs = final_adv_leaf(
+                    chunk_bins(c), node[c], g_c, h_c, feat, thr, n_leaf)
                 gsum = gs if gsum is None else gsum + gs
                 hsum = hs if hsum is None else hsum + hs
             if distributed:

@@ -51,6 +51,8 @@ _BLOCK_ROWS = 8192
 
 
 def histogram_methods() -> list[str]:
+    """Names of the available histogram engines (``auto`` resolves per
+    platform: Pallas on TPU, matmul/segment elsewhere)."""
     return ["auto", "segment", "matmul", "pallas"]
 
 

@@ -120,6 +120,8 @@ def world_size() -> int:
 
 
 def is_distributed() -> bool:
+    """True once :func:`init` has joined a multi-process
+    ``jax.distributed`` cluster (world size > 1)."""
     return jax.process_count() > 1
 
 

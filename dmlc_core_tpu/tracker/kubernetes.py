@@ -84,6 +84,9 @@ def build_manifest(
 
 def launch(nworker: int, command: List[str], envs: Dict[str, str],
            image: str, kubectl: str = "kubectl", **kw) -> List[int]:
+    """Launch ``nworker`` worker Pods running ``command`` with the DMLC env
+    ABI injected (TPU-slice nodeSelectors included); builds manifests
+    via :func:`build_worker_manifest` and applies them with kubectl."""
     manifest = build_manifest(nworker, command, envs, image, **kw)
     LOG("INFO", "kubernetes launch: job %s × %d", manifest["metadata"]["name"], nworker)
     p = subprocess.run([kubectl, "apply", "-f", "-"],

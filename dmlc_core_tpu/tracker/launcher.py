@@ -30,6 +30,8 @@ _RANK_VARS = [
 
 
 def task_id_from_env(env: Optional[Dict[str, str]] = None) -> int:
+    """Worker index assigned by the cluster manager, read from the DMLC
+    launcher env (``DMLC_TASK_ID``)."""
     env = os.environ if env is None else env
     for var in _RANK_VARS:
         if var in env and str(env[var]).strip() != "":
@@ -47,6 +49,8 @@ def prepare_env(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    """Entry point of the per-task launcher shim: re-execs ``command`` with
+    the tracker env applied (reference dmlc_tracker/launcher.py role)."""
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--":
         argv = argv[1:]

@@ -46,6 +46,8 @@ def build_command(
 
 def launch(nworker: int, command: List[str], envs: Dict[str, str],
            master: Optional[str] = None, **kw) -> List[int]:
+    """Launch workers through Mesos: builds per-task command/resource specs
+    (reference dmlc_tracker/mesos.py role) and submits them."""
     master = master or os.environ.get("MESOS_MASTER", "127.0.0.1:5050")
     procs = []
     for task_id in range(nworker):

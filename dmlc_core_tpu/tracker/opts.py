@@ -20,6 +20,9 @@ CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "yarn", "mesos", "kubernetes"
 
 
 def get_opts(args: Optional[List[str]] = None) -> Tuple[argparse.Namespace, List[str]]:
+    """Parse dmlc-submit command-line options; returns (namespace,
+    leftover worker command) with the same flag surface as the
+    reference dmlc_tracker/opts.py."""
     parser = argparse.ArgumentParser(
         prog="dmlc-submit",
         description="Submit a distributed dmlc_core_tpu job",

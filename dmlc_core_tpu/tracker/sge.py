@@ -52,6 +52,8 @@ def build_script(
 
 def launch(nworker: int, command: List[str], envs: Dict[str, str],
            qsub: str = "qsub", **kw) -> List[int]:
+    """Submit ``nworker`` array-job tasks to Sun Grid Engine with the DMLC
+    env ABI exported (reference dmlc_tracker/sge.py role)."""
     script = build_script(nworker, command, envs, **kw)
     fd, path = tempfile.mkstemp(prefix="dmlc_sge_", suffix=".sh")
     with os.fdopen(fd, "w") as f:

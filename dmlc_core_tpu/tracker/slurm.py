@@ -47,6 +47,8 @@ def build_command(
 
 def launch(nworker: int, command: List[str], envs: Dict[str, str],
            **kw) -> List[int]:
+    """Launch workers via ``srun`` with the DMLC env ABI exported
+    (reference dmlc_tracker/slurm.py role)."""
     cmd = build_command(nworker, command, envs, **kw)
     LOG("INFO", "slurm launch: %s", " ".join(cmd))
     return [subprocess.call(cmd, env=dict(os.environ))]

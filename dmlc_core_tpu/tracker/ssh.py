@@ -17,6 +17,8 @@ __all__ = ["launch", "read_host_file"]
 
 
 def read_host_file(path: str) -> List[str]:
+    """Read an MPI-style host file (one ``host[:slots]`` per line, ``#``
+    comments) into a host list."""
     hosts = []
     with open(path) as f:
         for line in f:

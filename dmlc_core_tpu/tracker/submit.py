@@ -23,6 +23,9 @@ __all__ = ["main"]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    """dmlc-submit entry point: parse opts, start the tracker, and launch
+    workers on the selected cluster backend (reference
+    dmlc_tracker/submit.py)."""
     opts, command = get_opts(argv)
     set_log_level(opts.log_level)
     CHECK(len(command) > 0, "no worker command given (use: dmlc-submit ... -- cmd)")

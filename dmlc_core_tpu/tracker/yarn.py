@@ -75,6 +75,8 @@ def build_command(
 
 def launch(nworker: int, command: List[str], envs: Dict[str, str],
            **kw) -> List[int]:
+    """Launch workers as YARN containers through the elastic Python AM
+    loop (reference dmlc_tracker/yarn.py + Java AM role)."""
     cmd = build_command(nworker, command, envs, **kw)
     LOG("INFO", "yarn launch: %s", " ".join(cmd))
     return [subprocess.call(cmd, env=dict(os.environ))]

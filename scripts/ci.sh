@@ -21,6 +21,14 @@ rm -rf build/lib build/bdist.* ./*.egg-info
 echo "== lint =="
 python scripts/lint.py
 
+echo "== api docs =="
+# regenerate doc/api/ and FAIL on undocumented __all__ exports
+# (SURVEY.md §2d's generated-API-reference role); then fail if the
+# committed pages are stale vs the source
+python scripts/gen_api_docs.py
+git diff --exit-code -- doc/api \
+    || { echo "doc/api is stale: commit the regenerated pages"; exit 1; }
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

@@ -113,6 +113,46 @@ class TestGBLinear:
         np.testing.assert_allclose(m_it.weights, m_core.weights,
                                    rtol=1e-4, atol=1e-5)
 
+    def test_fit_iter_small_slabs_match_one_put(self, tmp_path):
+        """Streaming device assembly (rows_per_upload smaller than a
+        page, forcing many donated slab writes incl. a partial tail)
+        must produce the exact model of the one-put dense path."""
+        from dmlc_core_tpu.data.iter import RowBlockIter
+
+        X, yc, _ = _linear_problem(n=1100, F=5)
+        y = (yc > 0.3).astype(np.float32)
+        svm = tmp_path / "lin.svm"
+        with open(svm, "w") as f:
+            for i in range(len(y)):
+                feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(5))
+                f.write(f"{int(y[i])} {feats}\n")
+        it = RowBlockIter.create(str(svm), 0, 1, "libsvm")
+        m_it = GBLinear(n_rounds=30, objective="binary:logistic")
+        m_it.fit_iter(it, num_col=5, rows_per_upload=256)  # 4 full + tail
+        it.close()
+        m_core = GBLinear(n_rounds=30, objective="binary:logistic")
+        m_core.fit(X, y)
+        np.testing.assert_allclose(m_it.weights, m_core.weights,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(m_it.bias, m_core.bias,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bfloat16_features_match_f32_oracle(self):
+        """feature_dtype=bfloat16 (half the H2D bytes at 50M scale) must
+        land within the damped coordinate step's tolerance of the f32
+        fit: same support/signs, close weights, matching predictions."""
+        X, yc, _ = _linear_problem(n=4000, F=6)
+        y = (yc > 0.3).astype(np.float32)
+        f32 = GBLinear(n_rounds=60, objective="binary:logistic")
+        f32.fit(X, y)
+        bf16 = GBLinear(n_rounds=60, objective="binary:logistic",
+                        feature_dtype="bfloat16")
+        bf16.fit(X, y)
+        np.testing.assert_allclose(bf16.weights, f32.weights,
+                                   rtol=0.05, atol=0.02)
+        agree = ((bf16.predict(X) > 0.5) == (f32.predict(X) > 0.5)).mean()
+        assert agree > 0.99, agree
+
     def test_save_load_roundtrip(self, tmp_path):
         X, yc, _ = _linear_problem(n=1000)
         m = GBLinear(n_rounds=20, objective="reg:squarederror")
