@@ -233,14 +233,20 @@ class _PairwiseRank(_ObjectiveBase):
 
 
 def _host_bin_requested() -> bool:
-    """True when DMLC_TPU_BIN_BACKEND requests host-side binning (any
-    non-empty value; False = bin where the data lives).  Through a
-    remote-device tunnel, host binning uploads the 4×-smaller uint8
-    matrix instead of f32 features; see the call sites for the measured
-    trade-offs."""
+    """True when ``DMLC_TPU_BIN_BACKEND=cpu`` requests host-side numpy
+    binning (unset/empty = bin where the data lives).  Any other value
+    is fatal — historically this knob named a jax backend, and silently
+    routing e.g. ``tpu`` (or a typo) to the single-core host loop would
+    invert the operator's intent.  Through a remote-device tunnel, host
+    binning uploads the 4×-smaller uint8 matrix instead of f32
+    features; see the call sites for the measured trade-offs."""
     from dmlc_core_tpu.base.parameter import get_env
 
-    return bool(get_env("DMLC_TPU_BIN_BACKEND", "", str))
+    backend = get_env("DMLC_TPU_BIN_BACKEND", "", str)
+    if backend in ("", "cpu"):
+        return backend == "cpu"
+    log_fatal(f"DMLC_TPU_BIN_BACKEND={backend!r}: only 'cpu' (host numpy "
+              f"binning) or unset (bin on the data's device) are valid")
 
 
 def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray) -> np.ndarray:
@@ -1108,7 +1114,8 @@ class HistGBT:
                                              warmup_rounds)
         return self._fit_external_chunked(pages, F, eval_every, distributed,
                                           budget=budget,
-                                          cache_all=cache_device)
+                                          cache_all=cache_device,
+                                          warmup_rounds=warmup_rounds)
 
     def _fit_external_cached(self, pages, F: int, eval_every: int,
                              warmup_rounds: int = 0) -> "HistGBT":
@@ -1169,7 +1176,8 @@ class HistGBT:
 
     def _fit_external_chunked(self, pages, F: int, eval_every: int,
                               distributed: bool, budget: int,
-                              cache_all: bool = False) -> "HistGBT":
+                              cache_all: bool = False,
+                              warmup_rounds: int = 0) -> "HistGBT":
         """Bounded-device-memory boosting over page-stacked chunks.
 
         Replaces the r3 per-page loop, which paid O(pages·depth)
@@ -1402,8 +1410,10 @@ class HistGBT:
             leaf = fl[3 * d:]
             return feats, thrs, gains, leaf
 
-        t0 = get_time()
-        for r in range(p.n_trees):
+        def one_round(r, record):
+            """One boosting round; ``record=False`` discards the result
+            (warmup: compiles gh/hist/split/advance/leaf/pack programs
+            and leaves preds/trees untouched)."""
             feat_mask = None                 # same RNG as the r3 page loop
             if p.colsample_bytree < 1.0:
                 crng = np.random.default_rng([p.seed, r, 1])
@@ -1425,7 +1435,8 @@ class HistGBT:
                         keep[c, off:off + take] = draws[done:done + take]
                         done += take
                         kpos += take
-                wk = [jnp.asarray(w_h[c] * keep[c]) for c in range(n_chunks)]
+                wk = [jnp.asarray(w_h[c] * keep[c])
+                      for c in range(n_chunks)]
             else:
                 wk = w_d
             g_d, h_d = [], []
@@ -1436,6 +1447,9 @@ class HistGBT:
             if K_cls == 1:
                 feats, thrs, gains, leaf, node = grow_one_tree(
                     None, feat_mask, g_d, h_d)
+                if not record:
+                    unpack_tree(pack_tree(feats, thrs, gains, leaf))
+                    return
                 for c in range(n_chunks):
                     preds_d[c] = upd_preds(preds_d[c], node[c], leaf, None)
                 f, t, gn, lf = unpack_tree(pack_tree(feats, thrs, gains,
@@ -1447,17 +1461,34 @@ class HistGBT:
                 for col in range(K_cls):
                     feats, thrs, gains, leaf, node = grow_one_tree(
                         col, feat_mask, g_d, h_d)
+                    if not record:
+                        unpack_tree(pack_tree(feats, thrs, gains, leaf))
+                        continue
                     for c in range(n_chunks):
                         preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
                                                col)
                     per_class.append(unpack_tree(
                         pack_tree(feats, thrs, gains, leaf)))
+                if not record:
+                    return
                 self.trees.append({
                     "feat": np.stack([t[0] for t in per_class]),
                     "thr": np.stack([t[1] for t in per_class]),
                     "gain": np.stack([t[2] for t in per_class]),
                     "leaf": np.stack([t[3] for t in per_class]),
                 })
+
+        t_w = get_time()
+        if warmup_rounds > 0:
+            # ONE discarded round compiles every per-level program (the
+            # full set is ~2·depth+5 jits — minutes of remote compile
+            # through a tunnel if left inside the timed region)
+            one_round(0, record=False)
+        warmup_s = get_time() - t_w
+
+        t0 = get_time()
+        for r in range(p.n_trees):
+            one_round(r, record=True)
             if eval_every and (r + 1) % eval_every == 0:
                 # mean of per-row losses across all chunks (pad rows
                 # excluded by the static n_valid slice), then the
@@ -1471,7 +1502,7 @@ class HistGBT:
         # the chunk loop has no dispatch-chunk evidence; stale numbers
         # from an earlier in-core fit must not describe this run
         self.last_chunk_times = []
-        self.last_warmup_seconds = None
+        self.last_warmup_seconds = warmup_s if warmup_rounds > 0 else None
         # margins live padded per chunk, not as one train-order vector
         self._train_preds = None
         self._n_real_rows = None

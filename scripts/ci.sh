@@ -26,16 +26,38 @@ echo "== api docs =="
 # (SURVEY.md §2d's generated-API-reference role); then fail if the
 # committed pages are stale vs the source
 python scripts/gen_api_docs.py
-git diff --exit-code -- doc/api \
-    || { echo "doc/api is stale: commit the regenerated pages"; exit 1; }
+# modified pages AND brand-new untracked pages both fail the gate
+if ! git diff --exit-code -- doc/api \
+        || [[ -n "$(git status --porcelain -- doc/api)" ]]; then
+    echo "doc/api is stale: commit the regenerated pages"
+    exit 1
+fi
 
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"
 fi
 
-echo "== pytest =="
-python -m pytest tests/ -q -x
+echo "== pytest (two lanes: fast + slow) =="
+# Full coverage, split into two lanes so the subprocess/sleep-heavy
+# slow lane overlaps the CPU-bound fast lane where the host allows
+# (xdist is unavailable offline; this is the VERDICT r3 #8 two-lane
+# split).  Each lane keeps -x; both exit codes are enforced.
+python -m pytest tests/ -q -x -m "not slow" > /tmp/ci_fast_lane.log 2>&1 &
+FAST_PID=$!
+python -m pytest tests/ -q -x -m "slow" > /tmp/ci_slow_lane.log 2>&1 &
+SLOW_PID=$!
+FAST_RC=0; SLOW_RC=0
+wait "$FAST_PID" || FAST_RC=$?
+wait "$SLOW_PID" || SLOW_RC=$?
+tail -3 /tmp/ci_fast_lane.log
+tail -3 /tmp/ci_slow_lane.log
+if [[ $FAST_RC -ne 0 || $SLOW_RC -ne 0 ]]; then
+    echo "pytest lanes failed (fast=$FAST_RC slow=$SLOW_RC); full logs:"
+    [[ $FAST_RC -ne 0 ]] && cat /tmp/ci_fast_lane.log
+    [[ $SLOW_RC -ne 0 ]] && cat /tmp/ci_slow_lane.log
+    exit 1
+fi
 
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native sanitizers =="

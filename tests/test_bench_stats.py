@@ -9,6 +9,7 @@ an oracle stronger than the learning tests."""
 import os
 import sys
 
+import pytest
 import numpy as np
 
 import jax
@@ -66,6 +67,7 @@ class TestChunkStats:
 
 
 class TestPairwiseRankAutodiffOracle:
+    @pytest.mark.slow
     def test_grad_and_hessian_match_autodiff(self):
         """g must equal jax.grad of the summed pairwise loss and h the
         exact diagonal of its Hessian (RankNet's per-pair rho sums ARE

@@ -68,6 +68,7 @@ class TestBERT:
         losses = [m.train_step(tokens, labels, mask) for _ in range(10)]
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_sharded_equals_replicated(self):
         """THE tp/sp/dp oracle: an 8-way (2,2,2) mesh must reproduce the
         1-device loss trajectory (bf16 tolerance)."""
@@ -80,6 +81,7 @@ class TestBERT:
             trajs.append([m.train_step(tokens, labels, mask) for _ in range(4)])
         np.testing.assert_allclose(trajs[0], trajs[1], rtol=2e-2)
 
+    @pytest.mark.slow
     def test_fit_chunked_matches_per_step(self):
         """The scan-chunked multi-step program (fit_chunked, the
         remote-tunnel bench path) must reproduce the per-step train_step
@@ -113,6 +115,7 @@ class TestBERT:
         assert secs > 0
         assert chunk_times[-1][0] == 4      # all steps accounted for
 
+    @pytest.mark.slow
     def test_save_load_roundtrip(self, tmp_path):
         """Checkpoint (Stream/serializer layer) must restore params AND
         momentum so a resumed model continues the exact trajectory."""
@@ -133,6 +136,7 @@ class TestBERT:
         with pytest.raises(Error, match="magic"):
             PipelineLM.load_model(uri)
 
+    @pytest.mark.slow
     def test_kvstore_first_step_matches_fused(self):
         mesh = create_mesh(MeshSpec(data=4, seq=2))
         tokens, labels, mask = _batch(seed=2)
@@ -169,6 +173,7 @@ class TestBERTMoE:
               capacity_factor=8.0)
 
     @pytest.mark.parametrize("partial_mask", [False, True])
+    @pytest.mark.slow
     def test_ep_matches_unsharded(self, partial_mask):
         """dp×ep must track the unsharded run exactly — including under
         PARTIAL masks, where the aux must weight routing stats by tokens
@@ -201,6 +206,7 @@ class TestBERTMoE:
         if not partial_mask:
             assert losses[-1] < losses[0] - 0.1   # and it learns
 
+    @pytest.mark.slow
     def test_capacity_pressure_sharded(self):
         """Under capacity pressure exact sharded/unsharded parity is NOT
         a contract: capacity binds per dispatch group (each token shard
@@ -319,6 +325,7 @@ class TestUlysses:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]      # actually learns
 
+    @pytest.mark.slow
     def test_bert_ring_vs_ulysses_first_step(self):
         """Same init, same batch: the two SP methods must produce the same
         first-step loss (both are exact attention)."""

@@ -4,6 +4,7 @@ external-memory hist-GBT over CSR pages.
 
 import os
 
+import pytest
 import numpy as np
 
 from dmlc_core_tpu.io.filesystem import TemporaryDirectory
@@ -300,6 +301,7 @@ class TestChunkedStreamingEngine:
                                        ext.predict(X[:256]),
                                        rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.slow
     def test_forced_chunked_multiclass(self, monkeypatch):
         rng = np.random.default_rng(5)
         X = rng.normal(size=(3_000, 5)).astype(np.float32)
@@ -361,6 +363,7 @@ class TestChunkedStreamingEngine:
         assert ll < 0.55, ll
 
 
+@pytest.mark.slow
 def test_external_memory_multiclass(tmp_path):
     """fit_external with multi:softmax must match in-core fit() given the
     same cuts (same data, single worker, deterministic splits)."""

@@ -307,6 +307,7 @@ class TestGBTExtras:
         with pytest.raises(Error):
             HistGBT.load_model(str(bad))
 
+    @pytest.mark.slow
     def test_subsample_colsample_train(self):
         from dmlc_core_tpu.models import HistGBT
 
@@ -425,6 +426,7 @@ class TestGBTExtras:
         # informative features (0,1,2) must dominate the noise ones
         assert imp[:3].sum() > imp[3:].sum()
 
+    @pytest.mark.slow
     def test_continue_training(self, tmp_path):
         from dmlc_core_tpu.models import HistGBT
 
@@ -519,6 +521,7 @@ class TestMulticlass:
         np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
         assert (proba.argmax(1) == pred).all()
 
+    @pytest.mark.slow
     def test_save_load_and_continue(self, tmp_path):
         from dmlc_core_tpu.models import HistGBT
 
@@ -557,6 +560,7 @@ class TestMulticlass:
         with pytest.raises(Error):
             HistGBT(num_class=3)                         # objective not multi
 
+    @pytest.mark.slow
     def test_sharded_equals_replicated_multiclass(self):
         from dmlc_core_tpu.models import HistGBT
         from dmlc_core_tpu.parallel.mesh import local_mesh
@@ -574,6 +578,7 @@ class TestMulticlass:
             np.testing.assert_allclose(t8["leaf"], t1["leaf"],
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_continue_then_early_stop_offsets_best_iteration(self, tmp_path):
         from dmlc_core_tpu.models import HistGBT
 
@@ -754,6 +759,7 @@ class TestMonotoneConstraints:
             out[:, j] = m.predict(Xs, output_margin=True)
         return out
 
+    @pytest.mark.slow
     def test_increasing_constraint_enforced(self):
         from dmlc_core_tpu.models import HistGBT
 

@@ -6,6 +6,7 @@ math exactly — same loss trajectory, same per-parameter updates — on the
 sequential stage application.  SURVEY.md §2e lists PP absent upstream;
 this is the beyond-parity row."""
 
+import pytest
 import numpy as np
 
 import jax
@@ -82,6 +83,7 @@ class TestPipelineLM:
                 rng.integers(0, V, size=(B, S)).astype(np.int32),
                 np.ones((B, S), np.float32))
 
+    @pytest.mark.slow
     def test_matches_unpipelined_exactly(self, rng):
         tokens, labels, mask = self._data(rng)
         m1 = PipelineLM(mesh=_mesh(2, 4), **self.KW)
@@ -99,6 +101,7 @@ class TestPipelineLM:
                                        np.asarray(m0.params[k]),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_learns(self, rng):
         tokens, labels, mask = self._data(rng)
         m = PipelineLM(mesh=_mesh(2, 2), learning_rate=0.05, **self.KW)
@@ -106,6 +109,7 @@ class TestPipelineLM:
         losses = [m.train_step(tokens, labels, mask) for _ in range(8)]
         assert losses[-1] < losses[0] - 0.1, losses
 
+    @pytest.mark.slow
     def test_save_load_roundtrip_across_pipe_widths(self, rng, tmp_path):
         """A checkpoint written from a pipelined mesh must load onto a
         plain data mesh (pipe-sharded slabs gather on save) and keep the
@@ -123,6 +127,7 @@ class TestPipelineLM:
         l_load = m2.train_step(tokens, labels, mask)
         np.testing.assert_allclose(l_load, l_orig, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_fit_chunked_matches_per_step(self, rng):
         """The scan-chunked program (tunnel bench path) must reproduce
         the per-step trajectory exactly on the pipelined mesh."""

@@ -64,6 +64,7 @@ class TestRankingMetrics:
 
 
 class TestPairwiseRankObjective:
+    @pytest.mark.slow
     def test_learns_to_rank(self):
         X, y, qid = _ltr_problem()
         m = HistGBT(n_trees=40, max_depth=3, n_bins=32,
@@ -75,6 +76,7 @@ class TestPairwiseRankObjective:
         assert acc > 0.85, acc               # chance = 0.5
         assert nd > 0.85, nd
 
+    @pytest.mark.slow
     def test_mesh_matches_single_device(self):
         """Groups never straddle shards, so pairwise grads are
         shard-local and the 8-way mesh must reproduce the 1-device

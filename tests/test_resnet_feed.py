@@ -101,6 +101,7 @@ class TestDeviceFeed:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_forward_shapes_all_variants_config(self):
         # construct (not run) every variant; run the micro one
         for name, (stages, bottleneck) in RESNET_STAGES.items():
@@ -114,6 +115,7 @@ class TestResNet:
         assert logits.shape == (2, 4)
         assert logits.dtype == np.float32
 
+    @pytest.mark.slow
     def test_end_to_end_training_from_recordio(self, tmp_path):
         """Config 2 in miniature: labels are recoverable from the images
         (label encoded in pixel intensity), loss must fall."""

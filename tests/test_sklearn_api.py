@@ -21,6 +21,7 @@ def _cls_data(n=2000, seed=0):
 
 class TestClassifier:
     @pytest.mark.parametrize("booster", ["gbtree", "gblinear"])
+    @pytest.mark.slow
     def test_binary_with_string_ish_labels(self, booster):
         X, yb = _cls_data()
         y = np.where(yb, "pos", "neg")        # non-numeric labels
@@ -32,6 +33,7 @@ class TestClassifier:
         assert proba.shape == (len(X), 2)
         np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
 
+    @pytest.mark.slow
     def test_multiclass_noncontiguous_labels(self):
         rng = np.random.default_rng(1)
         X = rng.normal(size=(1500, 5)).astype(np.float32)
@@ -64,6 +66,7 @@ class TestClassifier:
 
 class TestRegressor:
     @pytest.mark.parametrize("booster", ["gbtree", "gblinear"])
+    @pytest.mark.slow
     def test_r2(self, booster):
         rng = np.random.default_rng(2)
         X = rng.normal(size=(2000, 5)).astype(np.float32)
@@ -74,6 +77,7 @@ class TestRegressor:
 
 
 class TestRanker:
+    @pytest.mark.slow
     def test_ndcg(self):
         rng = np.random.default_rng(3)
         w = rng.normal(size=5)
@@ -111,6 +115,7 @@ class TestWrapperCheckpoint:
 
 
 class TestSklearnComposition:
+    @pytest.mark.slow
     def test_pipeline_and_grid_search(self):
         sklearn = pytest.importorskip("sklearn")  # noqa: F841
         from sklearn.model_selection import GridSearchCV

@@ -142,6 +142,7 @@ class TestOpts:
                 opts.image, opts.max_attempts) == ("q", 8, 1024, "img", 5)
 
 
+@pytest.mark.slow
 def test_dmlc_submit_cli_local_end_to_end(tmp_path):
     """The real CLI, as a user runs it: fork workers via --cluster=local,
     each worker connects to the tracker and reports its rank to a file."""
