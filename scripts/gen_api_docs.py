@@ -21,6 +21,7 @@ import importlib
 import inspect
 import os
 import pkgutil
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -64,9 +65,13 @@ def _public_symbols(mod):
 
 def _signature(obj):
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # default-value reprs of library sentinels embed process-specific
+    # memory addresses (e.g. flax's `_Sentinel object at 0x7f...`) —
+    # strip them or the staleness gate flaps on every run
+    return re.sub(r" at 0x[0-9a-fA-F]+", "", sig)
 
 
 def _doc_block(obj):
