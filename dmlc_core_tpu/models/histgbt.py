@@ -1745,17 +1745,21 @@ class HistGBT:
     #: scoring must not need training-scale memory)
     _PREDICT_BATCH = 2_000_000
 
-    def predict(self, X: np.ndarray, output_margin: bool = False,
-                n_trees: Optional[int] = None) -> np.ndarray:
-        CHECK(self.cuts is not None, "predict before fit")
-        CHECK(len(self.trees) > 0, "no trees trained")
-        p = self.param
-        X = np.ascontiguousarray(X, dtype=np.float32)
+    def _resolve_trees(self, n_trees: Optional[int]):
+        """Trees used for prediction: explicit count, else the
+        early-stop winner (XGBoost default), else all."""
         if n_trees is None and getattr(self, "_early_stopped", False) \
                 and self.best_iteration is not None:
-            n_trees = self.best_iteration + 1   # XGBoost early-stop default
-        use = self.trees if n_trees is None else self.trees[:n_trees]
-        stacked = self._stacked_trees(use)
+            n_trees = self.best_iteration + 1
+        return self.trees if n_trees is None else self.trees[:n_trees]
+
+    def _predict_stacked(self, X: np.ndarray, stacked,
+                         output_margin: bool) -> np.ndarray:
+        """Batched margin/transform over an already-stacked (device)
+        forest — shared by predict and predict_iter so the streaming
+        path uploads the model once."""
+        p = self.param
+        X = np.ascontiguousarray(X, dtype=np.float32)
         if len(X) == 0:
             return np.zeros(self._margin_shape(0), np.float32)
         outs = []
@@ -1770,6 +1774,41 @@ class HistGBT:
                 margin if output_margin else self._obj.transform(margin)))
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
+    def predict(self, X: np.ndarray, output_margin: bool = False,
+                n_trees: Optional[int] = None) -> np.ndarray:
+        CHECK(self.cuts is not None, "predict before fit")
+        CHECK(len(self.trees) > 0, "no trees trained")
+        stacked = self._stacked_trees(self._resolve_trees(n_trees))
+        return self._predict_stacked(X, stacked, output_margin)
+
+    def predict_iter(self, row_iter, output_margin: bool = False,
+                     n_trees: Optional[int] = None,
+                     batch_rows: int = _PREDICT_BATCH) -> np.ndarray:
+        """Streaming prediction over a :class:`RowBlockIter` — the
+        inference side of :meth:`fit_external` (a model trained
+        out-of-core must also SCORE out-of-core; XGBoost predicts
+        straight from a DMatrix).  CSR pages densify into a bounded
+        ``batch_rows`` staging slab that flows through the same batched
+        device path as :meth:`predict`; host memory holds one slab plus
+        the output vector, never the dense matrix.
+
+        The feature width is pinned by the trained cuts: pages whose
+        column index exceeds it fail loudly (a silently truncated
+        feature would score garbage)."""
+        from dmlc_core_tpu.data.iter import iter_dense_slabs
+
+        CHECK(self.cuts is not None, "predict before fit")
+        CHECK(len(self.trees) > 0, "no trees trained")
+        F = int(self.cuts.shape[0])
+        # stack + upload the forest ONCE, not per slab (50 slabs at 50M
+        # rows must not re-ship the model 50 times)
+        stacked = self._stacked_trees(self._resolve_trees(n_trees))
+        outs = [self._predict_stacked(xb, stacked, output_margin)
+                for xb, _, _ in iter_dense_slabs(row_iter, F, batch_rows)]
+        if not outs:
+            return np.zeros(self._margin_shape(0), np.float32)
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
     def predict_leaf(self, X: np.ndarray,
                      n_trees: Optional[int] = None) -> np.ndarray:
         """Per-tree leaf assignment — XGBoost's ``pred_leaf=True``.
@@ -1782,10 +1821,7 @@ class HistGBT:
         CHECK(self.cuts is not None, "predict before fit")
         CHECK(len(self.trees) > 0, "no trees trained")
         depth = self.param.max_depth
-        if n_trees is None and getattr(self, "_early_stopped", False) \
-                and self.best_iteration is not None:
-            n_trees = self.best_iteration + 1
-        use = self.trees if n_trees is None else self.trees[:n_trees]
+        use = self._resolve_trees(n_trees)
         stacked = self._stacked_trees(use)
         X = np.ascontiguousarray(X, dtype=np.float32)
         if len(X) == 0:

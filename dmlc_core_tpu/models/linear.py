@@ -277,44 +277,22 @@ class GBLinear:
             lambda buf, slab, lo: jax.lax.dynamic_update_slice(
                 buf, slab, (lo, 0)),
             donate_argnums=(0,))
+        from dmlc_core_tpu.data.iter import iter_dense_slabs
+
         R = max(1, min(rows_per_upload, n_tot))
-        stage = np.zeros((R, F), np.float32)
         y = np.zeros(n_tot, np.float32)
         w = np.zeros(n_tot, np.float32)
-        filled = 0          # rows staged but not yet flushed
-        base = 0            # device row offset of the staging slab
-        lo = 0              # total rows consumed
-
-        def flush(rows):
-            nonlocal x_d, base
+        lo = 0              # device row offset / total rows consumed
+        for xs, ys, ws in iter_dense_slabs(row_iter, F, R):
+            rows = len(ys)
             # astype/copy ALWAYS materializes a fresh slab: device_put
             # may alias the host buffer zero-copy (CPU backend), and the
-            # staging buffer is refilled immediately after this returns
-            slab = (stage[:rows].astype(dt) if dt is not np.float32
-                    else stage[:rows].copy())
-            x_d = write(x_d, jnp.asarray(slab), base)
-            base += rows
-
-        for b in row_iter:
-            done = 0
-            while done < b.size:
-                take = min(b.size - done, R - filled)
-                # CSR row-range views (RowBlock.slice) densify straight
-                # into the staging slab — even BasicRowIter's single
-                # whole-dataset block streams through in R-row pieces
-                b.slice(done, done + take).to_dense_into(
-                    stage[filled:filled + take])
-                y[lo:lo + take] = b.label[done:done + take]
-                w[lo:lo + take] = (b.weight[done:done + take]
-                                   if b.weight is not None else 1.0)
-                filled += take
-                done += take
-                lo += take
-                if filled == R:
-                    flush(R)
-                    filled = 0
-        if filled:
-            flush(filled)
+            # generator refills its staging buffer on the next yield
+            slab = (xs.astype(dt) if dt is not np.float32 else xs.copy())
+            x_d = write(x_d, jnp.asarray(slab), lo)
+            y[lo:lo + rows] = ys
+            w[lo:lo + rows] = ws
+            lo += rows
         CHECK(not (counted and lo == 0),
               "fit_iter: iterator yielded rows in the counting pass but "
               "none in the fill pass — it is not re-iterable (RowBlockIter "
@@ -335,6 +313,22 @@ class GBLinear:
         if output_margin or self.param.objective != "binary:logistic":
             return margin.astype(np.float32)
         return np.asarray(jax.nn.sigmoid(jnp.asarray(margin)))
+
+    def predict_iter(self, row_iter, output_margin: bool = False,
+                     batch_rows: int = 2_000_000) -> np.ndarray:
+        """Streaming prediction over a :class:`RowBlockIter` — score the
+        pages :meth:`fit_iter` trained on without ever holding the
+        dense matrix (one ``batch_rows`` staging slab bounds host
+        memory; each slab is a single numpy matvec)."""
+        from dmlc_core_tpu.data.iter import iter_dense_slabs
+
+        CHECK(self.weights is not None, "predict before fit")
+        F = len(self.weights)
+        outs = [self.predict(xb, output_margin=output_margin)
+                for xb, _, _ in iter_dense_slabs(row_iter, F, batch_rows)]
+        if not outs:
+            return np.zeros(0, np.float32)
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     # -- checkpointing --------------------------------------------------
     def save_model(self, uri: str) -> None:
