@@ -46,7 +46,24 @@ tracker/dmlc_tracker/        dmlc_core_tpu.tracker
 ==========================  =================================================
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"          # keep in sync with pyproject.toml
+
+import os as _os
+
+_force_n = _os.environ.get("DMLC_TPU_FORCE_CPU", "").strip()
+if _force_n and _force_n != "0":
+    # opt-in env hook: pin jax to N virtual CPU devices BEFORE anything
+    # touches a backend.  Lets examples/tools run safely on TPU
+    # terminals (where the platform plugin overrides JAX_PLATFORMS)
+    # without per-script code — CI smoke-runs every example this way.
+    # "0"/empty = disabled; anything else must be a device count.
+    if not _force_n.isdigit():
+        raise ValueError(
+            f"DMLC_TPU_FORCE_CPU={_force_n!r}: expected a device count "
+            f"(e.g. 2) or 0/unset to disable")
+    from dmlc_core_tpu.utils import force_cpu_devices as _force_cpu
+
+    _force_cpu(int(_force_n))
 
 from dmlc_core_tpu.base.logging import (  # noqa: F401
     Error,

@@ -31,8 +31,18 @@ def main():
     # '#cache' suffix → DiskRowIter: parse once, page through a cache file
     it = RowBlockIter.create(f"{svm}#{tmp}/cache.bin", 0, 1, "libsvm")
     model = HistGBT(n_trees=30, max_depth=5, n_bins=64, learning_rate=0.3)
+    # device memory bounded by DMLC_TPU_EXTERNAL_DEVICE_BUDGET: small
+    # datasets auto-run the in-core cached engine, big ones stream
+    # fixed-shape chunks per level
     model.fit_external(it, num_col=F, eval_every=10)
     print(f"out-of-core trained {len(model.trees)} trees")
+
+    # scoring is streaming too — the dense matrix never exists on the
+    # host, for training OR inference (iterating rewinds automatically)
+    preds = model.predict_iter(it)
+    acc = float(((preds > 0.5) == y).mean())
+    print(f"streamed predictions over {len(preds)} rows, train acc {acc:.3f}")
+    it.close()
 
 
 if __name__ == "__main__":

@@ -62,6 +62,9 @@ fi
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native sanitizers =="
     scripts/native_sanitize_test.sh
+
+    echo "== examples (forced-CPU smoke) =="
+    bash scripts/run_examples.sh
 fi
 
 echo "CI GREEN"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Smoke-run every example on forced CPU devices (DMLC_TPU_FORCE_CPU —
+# the package-level env hook), so the examples cannot rot silently and
+# never touch a real TPU from CI.  Each must exit 0.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export DMLC_TPU_FORCE_CPU="${DMLC_TPU_FORCE_CPU:-2}"
+
+log=$(mktemp)
+trap 'rm -f "$log"' EXIT
+fail=0
+for ex in examples/*.py; do
+    echo "== $ex =="
+    if ! timeout 300 python "$ex" > "$log" 2>&1; then
+        echo "EXAMPLE FAILED: $ex"
+        tail -20 "$log"
+        fail=1
+    else
+        tail -2 "$log"
+    fi
+done
+exit $fail
