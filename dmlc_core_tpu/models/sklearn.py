@@ -163,6 +163,22 @@ class GBTClassifier(_SkClf, _EstimatorBase):
         n_class = len(self.classes_)
         CHECK(n_class >= 2, "need at least 2 classes")
         codes = np.searchsorted(self.classes_, y).astype(np.float32)
+        if fit_kw.get("eval_set") is not None:
+            # validation labels go through the SAME encoding as y.
+            # XGBClassifier takes a LIST of (X, y) pairs and its early
+            # stopping watches the LAST one; a bare (X, y) tuple is
+            # accepted too.  String or non-contiguous labels would
+            # otherwise reach the booster raw.
+            ev = fit_kw["eval_set"]
+            if isinstance(ev, list):
+                CHECK(len(ev) > 0, "eval_set: empty list")
+                ev = ev[-1]
+            Xv, yv = ev
+            yv = np.asarray(yv)
+            CHECK(np.isin(yv, self.classes_).all(),
+                  "eval_set labels contain classes not present in y")
+            fit_kw["eval_set"] = (
+                Xv, np.searchsorted(self.classes_, yv).astype(np.float32))
         if n_class == 2:
             self._model = self._make("binary:logistic")
         else:

@@ -20,6 +20,31 @@ def _cls_data(n=2000, seed=0):
 
 
 class TestClassifier:
+    def test_eval_set_early_stopping_with_string_labels(self):
+        """XGBClassifier semantics: eval_set labels are encoded with the
+        SAME class mapping as y — string labels + early stopping must
+        work end to end, and unknown eval classes must fail loudly."""
+        X, yb = _cls_data(n=1500)
+        y = np.where(yb, "pos", "neg")
+        Xv, ybv = _cls_data(n=500, seed=3)
+        yv = np.where(ybv, "pos", "neg")
+        est = GBTClassifier(n_estimators=60, max_depth=3,
+                            learning_rate=0.4)
+        # XGBClassifier's list-of-pairs form (early stopping watches
+        # the last pair); the bare-tuple form is covered below
+        est.fit(X, y, eval_set=[(Xv, yv)], early_stopping_rounds=5)
+        assert est.model.best_iteration is not None
+        assert est.model.best_score is not None
+        acc = (est.predict(Xv) == yv).mean()
+        assert acc > 0.9, acc
+        est2 = GBTClassifier(n_estimators=20, max_depth=3,
+                             learning_rate=0.4)
+        est2.fit(X, y, eval_set=(Xv, yv))     # bare-tuple form
+        assert est2.model.best_score is not None
+        bad = np.where(ybv, "pos", "UNSEEN")
+        with pytest.raises(Exception, match="classes not present"):
+            GBTClassifier(n_estimators=5).fit(X, y, eval_set=(Xv, bad))
+
     @pytest.mark.parametrize("booster", ["gbtree", "gblinear"])
     @pytest.mark.slow
     def test_binary_with_string_ish_labels(self, booster):
