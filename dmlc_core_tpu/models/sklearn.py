@@ -147,6 +147,28 @@ class _EstimatorBase(_SkBase):
         CHECK(self._model is not None, "call fit first")
         return self._model
 
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        """Normalized gain importances (XGBClassifier's default
+        ``importance_type='gain'``, scaled to sum to 1 like sklearn's
+        own ensembles).  gblinear models expose |weight| instead, the
+        only importance a linear booster has."""
+        m = self.model
+        if self.booster == "gblinear":         # |w|: a linear model's
+            imp = np.abs(np.asarray(m.weights, np.float64))  # only notion
+        else:
+            imp = np.asarray(m.feature_importances("gain"), np.float64)
+        total = imp.sum()
+        return (imp / total if total > 0 else imp).astype(np.float32)
+
+    def apply(self, X: np.ndarray) -> np.ndarray:
+        """Per-tree leaf indices ``[n, T]`` (multiclass: ``[n, T, K]``,
+        matching ``predict_leaf``) — sklearn's ``apply`` / XGBoost's
+        ``pred_leaf``, the GBDT feature-embedding hook.  gbtree only."""
+        CHECK(self.booster == "gbtree",
+              "apply() needs the tree booster (booster='gbtree')")
+        return self.model.predict_leaf(X)
+
     def save_model(self, uri: str) -> None:
         self.model.save_model(uri)
 

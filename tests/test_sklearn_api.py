@@ -45,6 +45,28 @@ class TestClassifier:
         with pytest.raises(Exception, match="classes not present"):
             GBTClassifier(n_estimators=5).fit(X, y, eval_set=(Xv, bad))
 
+    def test_feature_importances_and_apply(self):
+        """sklearn-ensemble surface: normalized feature_importances_
+        (gain) and apply() leaf embeddings; gblinear falls back to |w|
+        importances and rejects apply()."""
+        X, yb = _cls_data(n=1500)
+        est = GBTClassifier(n_estimators=15, max_depth=3).fit(X, yb)
+        imp = est.feature_importances_
+        assert imp.shape == (X.shape[1],)
+        assert abs(float(imp.sum()) - 1.0) < 1e-5
+        # the informative features (0, 1, 2 drive the label via
+        # X0 + 0.5·X1·X2) dominate the pure-noise tail
+        assert imp[:3].sum() > imp[3:].sum()
+        leaves = est.apply(X[:64])
+        assert leaves.shape == (64, 15)
+        assert leaves.max() < 2 ** 3
+        lin = GBTClassifier(booster="gblinear", n_estimators=20).fit(X, yb)
+        limp = lin.feature_importances_
+        assert limp.shape == (X.shape[1],)
+        assert abs(float(limp.sum()) - 1.0) < 1e-5
+        with pytest.raises(Exception, match="gbtree"):
+            lin.apply(X[:4])
+
     @pytest.mark.parametrize("booster", ["gbtree", "gblinear"])
     @pytest.mark.slow
     def test_binary_with_string_ish_labels(self, booster):
