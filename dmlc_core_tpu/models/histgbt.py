@@ -1961,6 +1961,64 @@ class HistGBT:
         model._early_stopped = payload.get("early_stopped", False)
         return model
 
+    def dump_model(self, with_stats: bool = False) -> str:
+        """XGBoost-style text dump of the ensemble (``booster[i]:`` per
+        tree, one node per line) — the debugging/inspection surface of
+        ``Booster.dump_model``.
+
+        Node ids follow the complete-binary-tree layout these depth-wise
+        trees actually have: node ``n`` of level ``ℓ`` is id
+        ``2^ℓ−1+n`` with children ``2^(ℓ+1)−1+2n`` / ``+2n+1``; the leaf
+        layer sits at level ``max_depth``.  Split conditions print the
+        REAL feature threshold (``cuts[f][thr]`` — bins are internal),
+        as ``[f<N>≤x]`` with yes=left.  Degenerate nodes (no profitable
+        split: every row goes left) print as ``passthrough``.
+        ``with_stats`` appends each real split's stored gain."""
+        CHECK(len(self.trees) > 0, "no trees trained")
+        cuts = np.asarray(self.cuts)
+        B = self.param.n_bins
+        lines: List[str] = []
+
+        def dump_one(feat_t, thr_t, gain_t, leaf_t):
+            feat_t = np.asarray(feat_t)
+            thr_t = np.asarray(thr_t)
+            gain_t = None if gain_t is None else np.asarray(gain_t)
+            n_levels = feat_t.shape[0]
+            for level in range(n_levels):
+                n_nodes = 1 << level
+                for nid in range(n_nodes):
+                    gid = (1 << level) - 1 + nid
+                    f = int(feat_t[level][nid])
+                    t = int(thr_t[level][nid])
+                    kid = (1 << (level + 1)) - 1 + 2 * nid
+                    if t >= B - 1:
+                        lines.append(f"\t{gid}:passthrough "
+                                     f"yes={kid},no={kid + 1}")
+                        continue
+                    stat = ""
+                    if with_stats and gain_t is not None:
+                        stat = f",gain={float(gain_t[level][nid]):.6g}"
+                    lines.append(
+                        f"\t{gid}:[f{f}<{cuts[f][t]:.6g}] "
+                        f"yes={kid},no={kid + 1}{stat}")
+            base = (1 << n_levels) - 1
+            for i, v in enumerate(np.asarray(leaf_t)):
+                lines.append(f"\t{base + i}:leaf={float(v):.6g}")
+
+        for ti, tree in enumerate(self.trees):
+            feat_t = np.asarray(tree["feat"])
+            if feat_t.ndim == 3:            # multiclass [K, depth, half]
+                for c in range(feat_t.shape[0]):
+                    lines.append(f"booster[{ti}] class[{c}]:")
+                    dump_one(tree["feat"][c], tree["thr"][c],
+                             tree["gain"][c] if "gain" in tree else None,
+                             tree["leaf"][c])
+            else:
+                lines.append(f"booster[{ti}]:")
+                dump_one(tree["feat"], tree["thr"], tree.get("gain"),
+                         tree["leaf"])
+        return "\n".join(lines) + "\n"
+
     def feature_importances(self, importance_type: str = "weight"
                             ) -> np.ndarray:
         """Per-feature importance over the ensemble.

@@ -415,6 +415,72 @@ class TestGBTExtras:
             margin, m.predict(X, output_margin=True), rtol=1e-5,
             atol=1e-6)
 
+    def test_dump_model_text(self):
+        """The text dump is structurally faithful: 2^depth leaves per
+        tree whose values equal the stored leaf array, split thresholds
+        are real cut values for the named feature, and a hand-descent
+        of the dumped rules reproduces predict() on a probe row."""
+        import re
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = self._data()
+        m = HistGBT(n_trees=3, max_depth=3, n_bins=32, learning_rate=0.3)
+        m.fit(X, y)
+        dump = m.dump_model(with_stats=True)
+        assert dump.count("booster[") == 3
+        cuts = np.asarray(m.cuts)
+        for ti, tree in enumerate(m.trees):
+            sec = dump.split(f"booster[{ti}]:")[1].split("booster[")[0]
+            leaves = re.findall(r"(\d+):leaf=([-\d.e+]+)", sec)
+            assert len(leaves) == 8
+            np.testing.assert_allclose(
+                [float(v) for _, v in leaves], tree["leaf"],
+                rtol=1e-4, atol=1e-6)
+            for f, thr in re.findall(r"\[f(\d+)<([-\d.e+]+)\]", sec):
+                f, thr = int(f), float(thr)
+                assert np.isclose(cuts[f], thr, rtol=1e-3,
+                                  atol=1e-5).any(), (f, thr)
+        # hand-descend the dumped rules for one row, tree 0
+        sec = dump.split("booster[0]:")[1].split("booster[")[0]
+        nodes = {}
+        for line in sec.strip().splitlines():
+            line = line.strip()
+            mm = re.match(r"(\d+):\[f(\d+)<([-\d.e+]+)\] yes=(\d+),no=(\d+)",
+                          line)
+            if mm:
+                nodes[int(mm.group(1))] = (
+                    int(mm.group(2)), float(mm.group(3)),
+                    int(mm.group(4)), int(mm.group(5)))
+                continue
+            mm = re.match(r"(\d+):passthrough yes=(\d+),no=(\d+)", line)
+            if mm:
+                nodes[int(mm.group(1))] = (None, None,
+                                           int(mm.group(2)),
+                                           int(mm.group(3)))
+                continue
+            mm = re.match(r"(\d+):leaf=([-\d.e+]+)", line)
+            nodes[int(mm.group(1))] = ("leaf", float(mm.group(2)))
+        row = X[7]
+        nid = 0
+        while nodes[nid][0] != "leaf":
+            f, thr, yes, no = nodes[nid]
+            nid = yes if (f is None or row[f] < thr) else no
+        margin1 = nodes[nid][1]
+        # predict with ONLY tree 0: margin = base + leaf contribution
+        got = m.predict(row[None], output_margin=True, n_trees=1)[0]
+        np.testing.assert_allclose(got, m.param.base_score + margin1,
+                                   rtol=1e-4, atol=1e-6)
+        # multiclass dump: per-class sections with full leaf layers
+        rng = np.random.default_rng(3)
+        Xm = rng.normal(size=(600, 4)).astype(np.float32)
+        ym = (Xm[:, 0] > 0).astype(np.float32) + (Xm[:, 1] > 0.7)
+        mm3 = HistGBT(n_trees=2, max_depth=2, n_bins=16, num_class=3,
+                      objective="multi:softmax")
+        mm3.fit(Xm, ym)
+        d3 = mm3.dump_model()
+        assert d3.count("class[") == 6          # 2 trees x 3 classes
+        assert d3.count(":leaf=") == 6 * 4      # 2^2 leaves per section
+
     def test_feature_importances(self):
         from dmlc_core_tpu.models import HistGBT
 
