@@ -31,7 +31,7 @@ is ~1 full MXU row-pass instead of 6.
 from __future__ import annotations
 
 import os
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -54,6 +54,38 @@ from dmlc_core_tpu.parallel.mesh import local_mesh
 __all__ = ["HistGBT", "HistGBTParam", "OBJECTIVES"]
 
 OBJECTIVES: Registry = Registry.get("gbt_objective")
+
+#: process-wide compiled round programs, keyed on
+#: :meth:`HistGBT._round_fn_cache_key`.  Entries live for the process
+#: (compiled CPU/TPU executables are MB-scale; a test suite or sweep
+#: creates a few dozen distinct configs at most).  Each entry's own
+#: jax.jit cache additionally holds one executable per distinct padded
+#: input shape — a long-lived many-shape process can
+#: ``_ROUND_FN_CACHE.clear()`` to release everything.
+_ROUND_FN_CACHE: Dict[tuple, Any] = {}
+
+
+@lru_cache(maxsize=32)
+def _transpose_to_feature_major_fn(mesh: Mesh):
+    """Shared jitted ``[n, F] → [F, n]`` resharding transpose (per mesh —
+    a fresh per-fit lambda would recompile every call)."""
+    return jax.jit(
+        lambda b: b.T,
+        out_shardings=NamedSharding(mesh, P(None, "data")))
+
+
+# shape-keyed caches are BOUNDED: one entry per distinct dataset size,
+# and evicting the jit wrapper drops the last reference to its compiled
+# executables (pre-cache, per-instance closures freed with the instance)
+@lru_cache(maxsize=256)
+def _init_margin_fn(mesh: Mesh, shape: tuple, base_score: float,
+                    multiclass: bool):
+    """Shared jitted on-device base-score fill (see
+    :meth:`HistGBT._init_margin_device`)."""
+    sh = NamedSharding(mesh, P("data", None) if multiclass else P("data"))
+    return jax.jit(
+        lambda: jnp.full(shape, base_score, jnp.float32),
+        out_shardings=sh)
 
 
 class _ObjectiveBase:
@@ -387,6 +419,100 @@ def _leaf_sums(node, g, h, n_leaf):
             jax.ops.segment_sum(h, safe, num_segments=n_leaf))
 
 
+# -- chunked external-memory round pieces -----------------------------------
+# Module-level jits (config via static args) so jax.jit's cache — keyed on
+# function identity + statics + shapes — carries compiled programs across
+# fits and across HistGBT instances; defined as per-fit closures they
+# recompiled every call (~2·depth+5 programs, seconds each on a 1-core
+# host, minutes through a remote-compile tunnel).
+
+@partial(jax.jit, static_argnames=("obj", "multiclass"))
+def _ext_gh(preds, y, wk, *, obj, multiclass):
+    g, h = obj.grad_hess(preds, y)
+    w_col = wk[:, None] if multiclass else wk
+    return g * w_col, h * w_col
+
+
+@partial(jax.jit, static_argnames=("level", "col", "B", "method"))
+def _ext_adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev, *,
+                      level, col, B, method):
+    """Advance nodes one level (using the PREVIOUS level's split, level 0
+    skips it) then build this level's histogram — fused so a streamed
+    chunk's bins upload is consumed ONCE per level, not once for hist and
+    again for advance."""
+    if level > 0:
+        node = _advance_node(bins, node, feat_prev, thr_prev)
+    g_c = g if col is None else g[:, col]
+    h_c = h if col is None else h[:, col]
+    n_nodes = 1 << level
+    n_build = 1 if level == 0 else n_nodes >> 1
+    nd = node
+    if level > 0:
+        nd = jnp.where((nd >= 0) & (nd % 2 == 0), nd >> 1, -1)
+    return node, build_histogram(bins, nd, g_c, h_c, n_build, B,
+                                 method, transposed=True)
+
+
+@partial(jax.jit, static_argnames=("n_leaf",))
+def _ext_final_adv_leaf(bins, node, g_c, h_c, feat, thr, *, n_leaf):
+    """Last advance (deepest split) fused with the leaf g/h sums — again
+    one bins consumption for the level."""
+    node = _advance_node(bins, node, feat, thr)
+    gs, hs = _leaf_sums(node, g_c, h_c, n_leaf)
+    return node, gs, hs
+
+
+@partial(jax.jit, static_argnames=("level", "B"))
+def _ext_sib_stack(hist, prev_hist, *, level, B):
+    n_nodes = 1 << level
+    return jnp.stack([hist, prev_hist - hist], axis=2).reshape(
+        2, n_nodes, hist.shape[2], B)
+
+
+@lru_cache(maxsize=None)
+def _ext_split_fn(B, lam, gamma, mcw):
+    return jax.jit(_make_best_split(B, lam, gamma, mcw))
+
+
+@partial(jax.jit, static_argnames=("col", "n_leaf"))
+def _ext_upd_preds(preds, node, leaf, *, col, n_leaf):
+    gain = leaf[jnp.clip(node, 0, n_leaf - 1)]
+    if col is None:
+        return preds + gain
+    return preds.at[:, col].add(gain)
+
+
+@partial(jax.jit, static_argnames=("lam", "eta"))
+def _ext_leaf_calc(gsum, hsum, *, lam, eta):
+    return (-gsum / (hsum + lam) * eta).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("half",))
+def _ext_pack_tree(feats, thrs, gains, leaf, *, half):
+    """One flat f32 array per tree → ONE host fetch (feat/thr are small
+    ints, exact in f32)."""
+    fp = jnp.concatenate([jnp.pad(f, (0, half - f.shape[0]))
+                          for f in feats]).astype(jnp.float32)
+    tp = jnp.concatenate([jnp.pad(t, (0, half - t.shape[0]))
+                          for t in thrs]).astype(jnp.float32)
+    gp = jnp.concatenate([jnp.pad(g, (0, half - g.shape[0]))
+                          for g in gains])
+    return jnp.concatenate([fp, tp, gp, leaf])
+
+
+@partial(jax.jit, static_argnames=("nv", "obj"))
+def _ext_eval_loss(preds, y, *, nv, obj):
+    return jnp.sum(obj.row_loss(preds[:nv], y[:nv]))
+
+
+@lru_cache(maxsize=256)
+def _ext_const_fn(shape, fill, dtype_name):
+    """Cached jitted constant-fill (init margins / zero node vectors);
+    shape-keyed and bounded like :func:`_init_margin_fn`."""
+    dtype = np.dtype(dtype_name)
+    return jax.jit(lambda: jnp.full(shape, fill, dtype))
+
+
 def _metric_auc(margin, y):
     """ROC-AUC via the rank-sum (Mann-Whitney) identity with MIDRANKS for
     ties — GBT margins tie heavily (one tree = ≤2^depth distinct values),
@@ -603,9 +729,7 @@ class HistGBT:
             # the warm-start branch needs the row-major f32 upload anyway
             # (margin replay reads it), so it always bins on device
             bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
-            bins_t = jax.jit(
-                lambda b: b.T,
-                out_shardings=NamedSharding(self.mesh, P(None, "data")))(bins)
+            bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
             y_d = jax.device_put(y, row_sharding)
             w_d = jax.device_put(mask, row_sharding)
             margin_shape = self._margin_shape(n + n_pad)
@@ -906,9 +1030,7 @@ class HistGBT:
             # every boosting round (a full HBM round-trip per round).
             # Drop the row-major copy right away — keeping both layouts
             # would double the binned matrix's HBM residency.
-            bins_t = jax.jit(
-                lambda b: b.T,
-                out_shardings=NamedSharding(self.mesh, P(None, "data")))(bins)
+            bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
             bins.delete()
             del bins
         return {
@@ -926,11 +1048,8 @@ class HistGBT:
         for a constant the chip can materialize itself)."""
         p = self.param
         shape = self._margin_shape(n_padded)
-        sh = NamedSharding(
-            self.mesh, P("data", None) if p.num_class > 1 else P("data"))
-        return jax.jit(
-            lambda: jnp.full(shape, p.base_score, jnp.float32),
-            out_shardings=sh)()
+        return _init_margin_fn(self.mesh, shape, p.base_score,
+                               p.num_class > 1)()
 
     def fit_device(
         self,
@@ -1213,8 +1332,6 @@ class HistGBT:
         n_leaf = 1 << depth
         half = max(n_leaf >> 1, 1)
         method = p.hist_method
-        best_split = _make_best_split(B, p.reg_lambda, p.gamma,
-                                      p.min_child_weight)
 
         # -- chunk sizing against the device budget ---------------------
         page_rows = [len(pg["y"]) for pg in pages]
@@ -1281,10 +1398,9 @@ class HistGBT:
         y_d = [jnp.asarray(y_h[c]) for c in range(n_chunks)]
         w_d = [jnp.asarray(w_h[c]) for c in range(n_chunks)]
         mshape = (Rc, K_cls) if K_cls > 1 else (Rc,)
-        init_margin = jax.jit(
-            lambda: jnp.full(mshape, p.base_score, jnp.float32))
+        init_margin = _ext_const_fn(mshape, p.base_score, "float32")
         preds_d = [init_margin() for _ in range(n_chunks)]
-        zeros_node = jax.jit(lambda: jnp.zeros(Rc, jnp.int32))()
+        zeros_node = _ext_const_fn((Rc,), 0, "int32")()
         if not device_pages:
             bins_d = ([jnp.asarray(bins_h[c]) for c in range(n_chunks)]
                       if resident else None)
@@ -1292,74 +1408,25 @@ class HistGBT:
         def chunk_bins(c):
             return bins_d[c] if bins_d is not None else jnp.asarray(bins_h[c])
 
-        # -- jitted round pieces (fixed Rc → one compile each) -----------
-        @jax.jit
-        def gh_fn(preds, y, wk):
-            g, h = obj.grad_hess(preds, y)
-            w_col = wk if K_cls == 1 else wk[:, None]
-            return g * w_col, h * w_col
+        # -- round pieces: module-level jits (_ext_*) bound to this fit's
+        # config via static kwargs, so compiled programs persist across
+        # fits/instances in jax.jit's own cache
+        gh_fn = partial(_ext_gh, obj=obj, multiclass=K_cls > 1)
 
-        @partial(jax.jit, static_argnums=(6, 7))
         def adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev, level, col):
-            """Advance nodes one level (using the PREVIOUS level's split,
-            level 0 skips it) then build this level's histogram — fused
-            so a streamed chunk's bins upload is consumed ONCE per level,
-            not once for hist and again for advance."""
-            if level > 0:
-                node = _advance_node(bins, node, feat_prev, thr_prev)
-            g_c = g if col is None else g[:, col]
-            h_c = h if col is None else h[:, col]
-            n_nodes = 1 << level
-            n_build = 1 if level == 0 else n_nodes >> 1
-            nd = node
-            if level > 0:
-                nd = jnp.where((nd >= 0) & (nd % 2 == 0), nd >> 1, -1)
-            return node, build_histogram(bins, nd, g_c, h_c, n_build, B,
-                                         method, transposed=True)
+            return _ext_adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev,
+                                     level=level, col=col, B=B,
+                                     method=method)
 
-        @partial(jax.jit, static_argnums=(6,))
-        def final_adv_leaf(bins, node, g_c, h_c, feat, thr, _n_leaf):
-            """Last advance (deepest split) fused with the leaf g/h sums
-            — again one bins consumption for the level."""
-            node = _advance_node(bins, node, feat, thr)
-            gs, hs = _leaf_sums(node, g_c, h_c, _n_leaf)
-            return node, gs, hs
-
-        @partial(jax.jit, static_argnums=(2,))
-        def sib_stack(hist, prev_hist, level):
-            n_nodes = 1 << level
-            return jnp.stack([hist, prev_hist - hist], axis=2).reshape(
-                2, n_nodes, hist.shape[2], B)
-
-        split_fn = jax.jit(best_split)
-
-        @partial(jax.jit, static_argnums=(3,))
-        def upd_preds(preds, node, leaf, col):
-            gain = leaf[jnp.clip(node, 0, n_leaf - 1)]
-            if col is None:
-                return preds + gain
-            return preds.at[:, col].add(gain)
-
-        @jax.jit
-        def leaf_calc(gsum, hsum):
-            return (-gsum / (hsum + p.reg_lambda)
-                    * p.learning_rate).astype(jnp.float32)
-
-        @jax.jit
-        def pack_tree(feats, thrs, gains, leaf):
-            """One flat f32 array per tree → ONE host fetch (feat/thr are
-            small ints, exact in f32)."""
-            fp = jnp.concatenate([jnp.pad(f, (0, half - f.shape[0]))
-                                  for f in feats]).astype(jnp.float32)
-            tp = jnp.concatenate([jnp.pad(t, (0, half - t.shape[0]))
-                                  for t in thrs]).astype(jnp.float32)
-            gp = jnp.concatenate([jnp.pad(g, (0, half - g.shape[0]))
-                                  for g in gains])
-            return jnp.concatenate([fp, tp, gp, leaf])
-
-        @partial(jax.jit, static_argnums=(2,))
-        def eval_loss(preds, y, nv):
-            return jnp.sum(obj.row_loss(preds[:nv], y[:nv]))
+        final_adv_leaf = partial(_ext_final_adv_leaf, n_leaf=n_leaf)
+        sib_stack = partial(_ext_sib_stack, B=B)
+        split_fn = _ext_split_fn(B, p.reg_lambda, p.gamma,
+                                 p.min_child_weight)
+        upd_preds = partial(_ext_upd_preds, n_leaf=n_leaf)
+        leaf_calc = partial(_ext_leaf_calc, lam=p.reg_lambda,
+                            eta=p.learning_rate)
+        pack_tree = partial(_ext_pack_tree, half=half)
+        eval_loss = partial(_ext_eval_loss, obj=obj)
 
         def grow_one_tree(col, feat_mask, g_d, h_d):
             """One level-wise tree; returns device (feats, thrs, gains,
@@ -1382,7 +1449,7 @@ class HistGBT:
                 if distributed:
                     hist = coll.allreduce_device(hist)
                 if level > 0:
-                    hist = sib_stack(hist, prev_hist, level)
+                    hist = sib_stack(hist, prev_hist, level=level)
                 prev_hist = hist
                 feat, thr, gain = split_fn(hist, feat_mask)
                 feats.append(feat)
@@ -1393,7 +1460,7 @@ class HistGBT:
                 g_c = g_d[c] if col is None else g_d[c][:, col]
                 h_c = h_d[c] if col is None else h_d[c][:, col]
                 node[c], gs, hs = final_adv_leaf(
-                    chunk_bins(c), node[c], g_c, h_c, feat, thr, n_leaf)
+                    chunk_bins(c), node[c], g_c, h_c, feat, thr)
                 gsum = gs if gsum is None else gsum + gs
                 hsum = hs if hsum is None else hsum + hs
             if distributed:
@@ -1451,7 +1518,8 @@ class HistGBT:
                     unpack_tree(pack_tree(feats, thrs, gains, leaf))
                     return
                 for c in range(n_chunks):
-                    preds_d[c] = upd_preds(preds_d[c], node[c], leaf, None)
+                    preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
+                                           col=None)
                 f, t, gn, lf = unpack_tree(pack_tree(feats, thrs, gains,
                                                      leaf))
                 self.trees.append({"feat": f, "thr": t, "gain": gn,
@@ -1466,7 +1534,7 @@ class HistGBT:
                         continue
                     for c in range(n_chunks):
                         preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
-                                               col)
+                                               col=col)
                     per_class.append(unpack_tree(
                         pack_tree(feats, thrs, gains, leaf)))
                 if not record:
@@ -1494,7 +1562,8 @@ class HistGBT:
                 # excluded by the static n_valid slice), then the
                 # objective's finalizer — a chunk-wise mean of metrics
                 # would be wrong for non-additive metrics
-                num = sum(float(eval_loss(preds_d[c], y_d[c], n_valid[c]))
+                num = sum(float(eval_loss(preds_d[c], y_d[c],
+                                          nv=n_valid[c]))
                           for c in range(n_chunks) if n_valid[c])
                 loss = obj.finalize_mean_loss(num / max(N, 1))
                 LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
@@ -1509,9 +1578,38 @@ class HistGBT:
         return self
 
     # ------------------------------------------------------------------
+    def _round_fn_cache_key(self, n_features: int, n_rounds: int):
+        """Everything baked into the traced round program as a constant.
+
+        Two HistGBT instances with equal keys trace to the SAME program,
+        so the compiled executable is shared process-wide
+        (``_ROUND_FN_CACHE``) instead of recompiled per instance —
+        jax.jit's own cache is keyed on function identity, which a fresh
+        per-instance closure always misses (~5 s/compile on a 1-core
+        host, the dominant cost of small fits).
+        """
+        p = self.param
+        obj = self._obj
+        # registry objectives are per-name singletons (hashable as-is);
+        # _PairwiseRank is configured per fit → key on its config
+        obj_key = ((type(obj).__name__, obj.G, obj.QB)
+                   if isinstance(obj, _PairwiseRank) else obj)
+        mono = (tuple(int(v) for v in p.monotone_constraints)
+                if p.monotone_constraints else None)
+        return (self.mesh, n_features, n_rounds, p.max_depth, p.n_bins,
+                p.learning_rate, p.reg_lambda, p.gamma, p.min_child_weight,
+                p.hist_method, obj_key, mono, p.subsample,
+                p.colsample_bytree, p.num_class,
+                os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"))
+
     def _build_round_fn(self, n_features: int, n_rounds: int = 1):
         """Jitted shard_map program running ``n_rounds`` boosting rounds
         (lax.scan); returns (new_preds, trees stacked [n_rounds, ...])."""
+        cache_key = self._round_fn_cache_key(n_features, n_rounds)
+        cached = _ROUND_FN_CACHE.get(cache_key)
+        if cached is not None:
+            self._round_fn = cached
+            return cached
         p = self.param
         depth = p.max_depth
         B = p.n_bins
@@ -1534,7 +1632,13 @@ class HistGBT:
         best_split_leaf = _make_best_split(B, lam, gamma, mcw,
                                            with_child_sums=True,
                                            mono=mono_arr)
-        sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
+        # snapshot EVERY param the traced closure reads: the program is
+        # cached process-wide under the key above, and a later retrace
+        # (new input shape) must not see live mutations of some other
+        # instance's param object
+        subsample = p.subsample
+        colsample = p.colsample_bytree
+        sampling = subsample < 1.0 or colsample < 1.0
         # two-pass descend+hist measured faster than the fused kernel on
         # v5e (see ops.fused_descend_histogram); env knob for other HW
         fuse_levels = bool(int(
@@ -1553,18 +1657,17 @@ class HistGBT:
             """(row keep mask | None, feature mask | None) for one round."""
             keep = feat_mask = None
             key_rows, key_cols = jax.random.split(key)
-            if p.subsample < 1.0:
+            if subsample < 1.0:
                 # decorrelate row draws across shards; the tree built
                 # this round sees only the subsample (XGBoost
                 # semantics: leaf values come from the subsample too)
                 key_rows = jax.random.fold_in(
                     key_rows, jax.lax.axis_index("data"))
-                keep = jax.random.uniform(key_rows, row_shape) < p.subsample
-            if p.colsample_bytree < 1.0:
+                keep = jax.random.uniform(key_rows, row_shape) < subsample
+            if colsample < 1.0:
                 # same mask on every shard (key NOT folded); exact
                 # count like XGBoost: keep the ⌈c·F⌉ smallest scores
-                n_keep = max(1, int(np.ceil(
-                    p.colsample_bytree * n_features)))
+                n_keep = max(1, int(np.ceil(colsample * n_features)))
                 scores = jax.random.uniform(key_cols, (n_features,))
                 kth = jnp.sort(scores)[n_keep - 1]
                 feat_mask = scores <= kth
@@ -1735,6 +1838,7 @@ class HistGBT:
             check_vma=False,
         )
         self._round_fn = jax.jit(mapped, donate_argnums=(3,))
+        _ROUND_FN_CACHE[cache_key] = self._round_fn
         return self._round_fn
 
     # ------------------------------------------------------------------

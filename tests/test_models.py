@@ -903,3 +903,53 @@ class TestMonotoneConstraints:
                     monotone_constraints=[0.5, 0, 0, 0])
         with pytest.raises(Error):
             m.fit(X, y)
+
+
+class TestRoundProgramCache:
+    """The process-wide compiled-round-program cache
+    (histgbt._ROUND_FN_CACHE) must share programs across instances
+    without leaking one instance's live param mutations into another's
+    cached program."""
+
+    def test_identical_config_shares_program_and_trees(self):
+        from dmlc_core_tpu.models import HistGBT
+        from dmlc_core_tpu.models import histgbt as hg
+
+        X, y = _synthetic(n=1024, f=6, seed=11)
+        m1 = HistGBT(n_trees=4, max_depth=3, n_bins=32)
+        m1.fit(X, y)
+        key = m1._round_fn_cache_key(6, 4)
+        assert key in hg._ROUND_FN_CACHE
+        m2 = HistGBT(n_trees=4, max_depth=3, n_bins=32)
+        m2.fit(X, y)
+        assert m1._round_fn is m2._round_fn
+        for a, b in zip(m1.trees, m2.trees):
+            np.testing.assert_array_equal(a["feat"], b["feat"])
+            np.testing.assert_allclose(a["leaf"], b["leaf"], rtol=1e-6)
+
+    def test_param_mutation_does_not_poison_cache(self):
+        """Mutating instance A's param AFTER its fit must not change
+        what a fresh same-config instance B trains with — the cached
+        program snapshots every param at build time, and a RETRACE at a
+        new input shape must not re-read A's live (mutated) values."""
+        from dmlc_core_tpu.models import HistGBT
+
+        X, y = _synthetic(n=1024, f=6, seed=12)
+        a = HistGBT(n_trees=4, max_depth=3, n_bins=32, subsample=0.8)
+        a.fit(X, y)
+        a.param.subsample = 0.1          # hostile live mutation
+        b = HistGBT(n_trees=4, max_depth=3, n_bins=32, subsample=0.8)
+        # different row count -> padded shape differs -> jax retraces
+        # the cached closure; the retrace must see 0.8, not A's 0.1
+        X2, y2 = _synthetic(n=1700, f=6, seed=12)
+        b.fit(X2, y2)
+        # oracle: same fit through a CLEAN cache (a poisoned retrace
+        # would have trained b with 0.1 — comparing b against another
+        # hit of the same cached program would hide that)
+        from dmlc_core_tpu.models import histgbt as hg
+        hg._ROUND_FN_CACHE.clear()
+        c = HistGBT(n_trees=4, max_depth=3, n_bins=32, subsample=0.8)
+        c.fit(X2, y2)
+        for tb, tc in zip(b.trees, c.trees):
+            np.testing.assert_array_equal(tb["feat"], tc["feat"])
+            np.testing.assert_allclose(tb["leaf"], tc["leaf"], rtol=1e-6)
