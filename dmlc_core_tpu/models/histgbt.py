@@ -874,7 +874,14 @@ class HistGBT:
         early-stopping between dispatches.
         """
         p = self.param
-        K = min(p.n_trees, 25)
+        # rounds per dispatch: 25 amortizes per-dispatch latency while
+        # keeping ≥2 evidence chunks at the 100-round bench shape (the
+        # anomaly detector needs per-chunk arrival deltas); overridable
+        # for experiments / very different round counts
+        k_env = int(os.environ.get("DMLC_TPU_ROUNDS_PER_DISPATCH", 25))
+        CHECK(k_env >= 1,
+              f"DMLC_TPU_ROUNDS_PER_DISPATCH must be >= 1, got {k_env}")
+        K = min(p.n_trees, k_env)
         if eval_every:
             # chunk boundaries must land on eval rounds: use the largest
             # divisor of eval_every ≤ K (gcd alone would collapse to 1

@@ -28,8 +28,12 @@ class TestClassifier:
         y = np.where(yb, "pos", "neg")
         Xv, ybv = _cls_data(n=500, seed=3)
         yv = np.where(ybv, "pos", "neg")
+        # n_bins=64: what's under test is label encoding + early
+        # stopping, not bin resolution — the smaller program compiles
+        # ~4x faster on the 1-core CI host (256-bin default coverage
+        # lives in the other classifier tests)
         est = GBTClassifier(n_estimators=60, max_depth=3,
-                            learning_rate=0.4)
+                            learning_rate=0.4, n_bins=64)
         # XGBClassifier's list-of-pairs form (early stopping watches
         # the last pair); the bare-tuple form is covered below
         est.fit(X, y, eval_set=[(Xv, yv)], early_stopping_rounds=5)
@@ -38,7 +42,7 @@ class TestClassifier:
         acc = (est.predict(Xv) == yv).mean()
         assert acc > 0.9, acc
         est2 = GBTClassifier(n_estimators=20, max_depth=3,
-                             learning_rate=0.4)
+                             learning_rate=0.4, n_bins=64)
         est2.fit(X, y, eval_set=(Xv, yv))     # bare-tuple form
         assert est2.model.best_score is not None
         bad = np.where(ybv, "pos", "UNSEEN")
