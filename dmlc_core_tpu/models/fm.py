@@ -36,6 +36,11 @@ from dmlc_core_tpu.parallel.mesh import local_mesh
 
 __all__ = ["FM", "FMParam"]
 
+#: process-wide compiled Adam-step programs (see
+#: histgbt._ROUND_FN_CACHE for the policy): keyed on every config
+#: constant the trace bakes in.
+_STEP_FN_CACHE: Dict[tuple, Any] = {}
+
 
 class FMParam(Parameter):
     """Hyperparameters (libFM-compatible names where they exist)."""
@@ -108,6 +113,14 @@ class FM:
         p = self.param
         logistic = p.objective == "binary:logistic"
         lr, b1, b2, eps = p.learning_rate, 0.9, 0.999, 1e-8
+        # snapshot the remaining traced constants (reg terms) and share
+        # the compiled step across same-config instances
+        reg_w, reg_v = p.reg_w, p.reg_v
+        cache_key = (self.mesh, logistic, lr, reg_w, reg_v)
+        cached = _STEP_FN_CACHE.get(cache_key)
+        if cached is not None:
+            self._step_fn = cached
+            return
 
         def step(params, opt, x_l, y_l, w_l):
             def local_sum(ps):
@@ -128,11 +141,11 @@ class FM:
             grads = jax.tree.map(
                 lambda g: lax.psum(g, "data") / n_glob, grads)
             # analytic L2 grads (the reg term is replicated, not sharded)
-            grads["w"] = grads["w"] + 2 * p.reg_w * params["w"]
-            grads["v"] = grads["v"] + 2 * p.reg_v * params["v"]
+            grads["w"] = grads["w"] + 2 * reg_w * params["w"]
+            grads["v"] = grads["v"] + 2 * reg_v * params["v"]
             loss = (lax.psum(loss_sum, "data") / n_glob
-                    + p.reg_w * jnp.sum(params["w"] ** 2)
-                    + p.reg_v * jnp.sum(params["v"] ** 2))
+                    + reg_w * jnp.sum(params["w"] ** 2)
+                    + reg_v * jnp.sum(params["v"] ** 2))
             t = opt["t"] + 1
             tf = t.astype(jnp.float32)
 
@@ -155,6 +168,7 @@ class FM:
                       P("data", None), P("data"), P("data")),
             out_specs=(P(), {"m": P(), "s": P(), "t": P()}, P()),
             check_vma=False), donate_argnums=(0, 1))
+        _STEP_FN_CACHE[cache_key] = self._step_fn
 
     # -- training -------------------------------------------------------
     def _ndev(self) -> int:

@@ -27,7 +27,8 @@ reg:squarederror).  Checkpoints go through the Stream layer
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple
+from functools import lru_cache
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,6 +44,32 @@ from dmlc_core_tpu.models.histgbt import OBJECTIVES
 from dmlc_core_tpu.parallel.mesh import local_mesh
 
 __all__ = ["GBLinear", "GBLinearParam"]
+
+#: process-wide compiled K-round coordinate programs (see
+#: histgbt._ROUND_FN_CACHE for the policy): keyed on
+#: (mesh, K, objective, lr, lambda, alpha) — everything the trace bakes
+#: in.  ``_ROUNDS_FN_CACHE.clear()`` releases the executables.
+_ROUNDS_FN_CACHE: Dict[tuple, Any] = {}
+
+
+@lru_cache(maxsize=256)
+def _device_zeros_fn(mesh: Mesh, shape: tuple, dt):
+    """Cached jitted sharded-zeros builder for fit_iter's device matrix
+    (shape-keyed and bounded; a per-fit lambda recompiled every call).
+    ``dt`` comes from ``_np_feature_dtype`` so buffer and slab dtypes
+    share one mapping."""
+    return jax.jit(
+        lambda: jnp.zeros(shape, dt),
+        out_shardings=NamedSharding(mesh, P("data", None)))
+
+
+def _slab_write_impl(buf, slab, lo):
+    """Donated dynamic-update-slice slab upload (module-level so its
+    compiled programs persist across fits)."""
+    return jax.lax.dynamic_update_slice(buf, slab, (lo, 0))
+
+
+_slab_write = jax.jit(_slab_write_impl, donate_argnums=(0,))
 
 
 class GBLinearParam(Parameter):
@@ -98,11 +125,22 @@ class GBLinear:
                             for a in self.mesh.axis_names]))
 
     def _build_rounds_fn(self, K: int):
+        # process-wide program cache, same rationale as
+        # histgbt._ROUND_FN_CACHE: jax.jit keys on function identity, so
+        # per-instance closures recompile for every model (a GridSearchCV
+        # over GBLinear pays seconds per candidate x fold otherwise).
+        # Key = every config constant the trace bakes in; snapshot them
+        # into locals so a cached program's retrace cannot read a later
+        # live mutation of some instance's param
         p = self.param
         obj = self._obj
         lr = p.learning_rate
         lam = p.reg_lambda
         alpha = p.reg_alpha
+        cache_key = (self.mesh, K, obj, lr, lam, alpha)
+        cached = _ROUNDS_FN_CACHE.get(cache_key)
+        if cached is not None:
+            return cached
 
         def k_rounds(x_l, y_l, w_l, wvec, bias):
             def one_round(carry, _):
@@ -153,7 +191,9 @@ class GBLinear:
             in_specs=(P("data", None), P("data"), P("data"), P(), P()),
             out_specs=(P(), P()),
             check_vma=False)
-        return jax.jit(mapped)
+        fn = jax.jit(mapped)
+        _ROUNDS_FN_CACHE[cache_key] = fn
+        return fn
 
     def _np_feature_dtype(self):
         """numpy-compatible dtype of the device feature matrix
@@ -267,16 +307,11 @@ class GBLinear:
         pad = (-n) % ndev
         n_tot = n + pad
         dt = self._np_feature_dtype()
-        sh_m = NamedSharding(self.mesh, P("data", None))
         sh_r = NamedSharding(self.mesh, P("data"))
         # device-side zeros: pad rows are already correct, and partial
         # final slabs only need their REAL rows written
-        x_d = jax.jit(lambda: jnp.zeros((n_tot, F), dt),
-                      out_shardings=sh_m)()
-        write = jax.jit(
-            lambda buf, slab, lo: jax.lax.dynamic_update_slice(
-                buf, slab, (lo, 0)),
-            donate_argnums=(0,))
+        x_d = _device_zeros_fn(self.mesh, (n_tot, F), dt)()
+        write = _slab_write
         from dmlc_core_tpu.data.iter import iter_dense_slabs
 
         R = max(1, min(rows_per_upload, n_tot))
