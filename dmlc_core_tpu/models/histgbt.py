@@ -48,7 +48,8 @@ from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.ops.histogram import (build_histogram,
                                          fused_descend_histogram,
                                          select_feature_bins)
-from dmlc_core_tpu.ops.quantile import apply_bins, compute_cuts
+from dmlc_core_tpu.ops.quantile import (apply_bins, apply_bins_missing,
+                                        compute_cuts)
 from dmlc_core_tpu.parallel.mesh import local_mesh
 
 __all__ = ["HistGBT", "HistGBTParam", "OBJECTIVES"]
@@ -281,25 +282,33 @@ def _host_bin_requested() -> bool:
               f"binning) or unset (bin on the data's device) are valid")
 
 
-def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray) -> np.ndarray:
+def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray,
+                missing: bool = False) -> np.ndarray:
     """Bin ``X`` on the HOST and return the FEATURE-major bin matrix.
 
     Pure numpy searchsorted, feature by feature — same semantics as
     :func:`ops.quantile.apply_bins` (bin = #cuts ≤ value, side='right';
-    uint8 when bins fit).  Measured 22 s for 10M×28 on one core (r4),
-    replacing the earlier jax-CPU-backend detour, and the per-feature
-    loop never materializes a second full-matrix copy."""
-    dtype = np.uint8 if cuts_np.shape[1] < 256 else np.int32
+    uint8 when bins fit; ``missing=True`` sends NaN to the reserved top
+    bin like ``apply_bins_missing``).  Measured 22 s for 10M×28 on one
+    core (r4), replacing the earlier jax-CPU-backend detour, and the
+    per-feature loop never materializes a second full-matrix copy."""
+    miss_bin = cuts_np.shape[1] + 1
+    n_max = miss_bin if missing else cuts_np.shape[1]
+    dtype = np.uint8 if n_max < 256 else np.int32
     out = np.empty((X.shape[1], len(X)), dtype)
     for j in range(X.shape[1]):
-        out[j] = np.searchsorted(cuts_np[j], X[:, j],
-                                 side="right").astype(dtype)
+        col = np.searchsorted(cuts_np[j], X[:, j],
+                              side="right").astype(dtype)
+        if missing:
+            col[np.isnan(X[:, j])] = miss_bin
+        out[j] = col
     return out
 
 
 def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
                      with_child_sums: bool = False,
-                     mono: Optional[np.ndarray] = None):
+                     mono: Optional[np.ndarray] = None,
+                     missing: bool = False):
     """Greedy per-node split chooser over a gradient histogram.
 
     hist [2,N,F,B] → (feat [N], thr [N], split_gain [N]); degenerate
@@ -328,6 +337,18 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
     segment-sum path.  Split selection always had this property (gain is
     computed from the same histogram); extending it to leaf weights is
     the deliberate price of eliminating the dominant per-round pass.
+
+    ``missing=True`` (XGBoost's learned default direction; exclusive
+    with ``mono``, CHECKed at fit): bin ``B-1`` is reserved for NaN
+    rows (``apply_bins_missing``), value bins are ``0..B-2``.  Every
+    candidate threshold's gain is evaluated with the node's missing
+    mass on the left AND the right (the missing-right branch is
+    numerically the plain formula — value cumsums exclude bin B-1,
+    totals include it, so NaN-free nodes reduce exactly to the
+    unconstrained scan), and the better direction is recorded per node
+    as ``dir`` (1 = missing left), returned between thr and gain.
+    Degenerate nodes keep thr = B-1 / dir = 1: every row, missing
+    included, goes left.
     """
 
     def best_split(hist, feat_mask=None, bounds=None):
@@ -339,9 +360,29 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         hl = ch[..., :-1]
         gt = cg[..., -1:]                            # [N,F,1]
         ht = ch[..., -1:]
-        gr = gt - gl
-        hr = ht - hl
-        gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam) - gt**2 / (ht + lam))
+        dir_l = None
+        if missing:
+            miss_g = g[..., B - 1]                   # [N,F] NaN-bin mass
+            miss_h = h[..., B - 1]
+
+            def side_gain(gl_, hl_):
+                gr_ = gt - gl_
+                hr_ = ht - hl_
+                gn = (gl_**2 / (hl_ + lam) + gr_**2 / (hr_ + lam)
+                      - gt**2 / (ht + lam))
+                ok_ = (hl_ >= mcw) & (hr_ >= mcw)
+                return jnp.where(ok_, gn, -jnp.inf)
+
+            gain_r = side_gain(gl, hl)               # missing → right
+            gain_l = side_gain(gl + miss_g[..., None],
+                               hl + miss_h[..., None])
+            gain = jnp.maximum(gain_r, gain_l)
+            dir_l = gain_l > gain_r                  # [N,F,B-1] bool
+        else:
+            gr = gt - gl
+            hr = ht - hl
+            gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam)
+                    - gt**2 / (ht + lam))
         if mono is not None:
             # bounds bind the REALIZABLE child weights, so gain must be
             # evaluated at the clipped weights (XGBoost's constrained
@@ -367,8 +408,9 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
             m = jnp.asarray(mono)[None, :, None]     # [1, F, 1]
             viol = ((m > 0) & (wl > wr)) | ((m < 0) & (wl < wr))
             gain = jnp.where(viol, -jnp.inf, gain)
-        ok = (hl >= mcw) & (hr >= mcw)
-        gain = jnp.where(ok, gain, -jnp.inf)
+        if not missing:                  # missing folds mcw per direction
+            ok = (hl >= mcw) & (hr >= mcw)
+            gain = jnp.where(ok, gain, -jnp.inf)
         if feat_mask is not None:                    # colsample: [F] bool
             gain = jnp.where(feat_mask[None, :, None], gain, -jnp.inf)
         flat = gain.reshape(gain.shape[0], -1)       # [N, F*(B-1)]
@@ -379,20 +421,36 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         split_ok = 0.5 * best_gain > gamma
         feat = jnp.where(split_ok, feat, 0)
         thr = jnp.where(split_ok, thr, B - 1)        # bins ≤ B-1 → all left
+        if missing:
+            dirv = jnp.take_along_axis(
+                dir_l.reshape(dir_l.shape[0], -1), best[:, None],
+                axis=1)[:, 0].astype(jnp.int32)
+            dirv = jnp.where(split_ok, dirv, 1)      # degenerate: all left
         # XGBoost's reported split gain (0 for degenerate nodes) — kept in
         # the tree arrays so importance_type="gain" costs nothing extra
         split_gain = jnp.where(split_ok, 0.5 * best_gain, 0.0)
         if not with_child_sums:
-            return feat, thr, split_gain
+            return ((feat, thr, dirv, split_gain) if missing
+                    else (feat, thr, split_gain))
         N, F = g.shape[0], g.shape[1]
         n_idx = jnp.arange(N, dtype=jnp.int32)
         flat_idx = (n_idx * F + feat) * B + thr
         lg = cg.reshape(-1)[flat_idx]                # left-child sums [N]
         lh = ch.reshape(-1)[flat_idx]
+        if missing:
+            mg = miss_g.reshape(-1)[n_idx * F + feat]
+            mh = miss_h.reshape(-1)[n_idx * F + feat]
+            # degenerate thr = B-1 already includes the missing bin in
+            # its cumsum; adding mg again would double-count it
+            add_miss = (dirv == 1) & (thr < B - 1)
+            lg = lg + jnp.where(add_miss, mg, 0.0)
+            lh = lh + jnp.where(add_miss, mh, 0.0)
         tg = cg[:, 0, -1]                            # node totals (any feature)
         th_ = ch[:, 0, -1]
         child_g = jnp.stack([lg, tg - lg], axis=1).reshape(2 * N)
         child_h = jnp.stack([lh, th_ - lh], axis=1).reshape(2 * N)
+        if missing:
+            return feat, thr, dirv, split_gain, child_g, child_h
         return feat, thr, split_gain, child_g, child_h
 
     return best_split
@@ -632,6 +690,12 @@ class HistGBT:
                   f"(allowed: {sorted(allowed)})")
         self._obj = OBJECTIVES[self.param.objective]
         self.cuts: Optional[jax.Array] = None          # [F, n_bins-1]
+        #: NaN-as-missing mode (XGBoost learned default direction),
+        #: auto-detected from the training data: bin n_bins-1 is
+        #: reserved for NaN, trees carry a per-node "dir" array, and
+        #: descend routes missing rows by it.  Sticky for the model's
+        #: lifetime (cuts/trees are mode-specific) and persisted.
+        self._missing: bool = False
         self.trees: List[Dict[str, np.ndarray]] = []   # per-tree arrays
         self._round_fn = None
         self.last_fit_seconds: Optional[float] = None
@@ -725,10 +789,11 @@ class HistGBT:
         K_cls = p.num_class
         if continuing:
             CHECK(self.cuts is not None, "continue-fit without cuts")
+            self._check_nan_allowed(X, "fit (continued)")
             X, y, mask, n_pad = self._pad_rows(X, y, weight)
             # the warm-start branch needs the row-major f32 upload anyway
             # (margin replay reads it), so it always bins on device
-            bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
+            bins = self._bin_matrix(jax.device_put(X, mat_sharding))
             bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
             y_d = jax.device_put(y, row_sharding)
             w_d = jax.device_put(mask, row_sharding)
@@ -758,7 +823,8 @@ class HistGBT:
         if eval_set is not None:
             Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
             yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
-            eval_bins = apply_bins(jnp.asarray(Xv), self.cuts)
+            self._check_nan_allowed(Xv, "eval_set")
+            eval_bins = self._bin_matrix(jnp.asarray(Xv))
             eval_margin = jnp.full(self._margin_shape(len(yv)),
                                    p.base_score, jnp.float32)
             if continuing:
@@ -955,6 +1021,28 @@ class HistGBT:
             return coll.allgather
         return None
 
+    def _miss_bin(self) -> int:
+        """The reserved NaN bin (``n_bins-1``; = #cuts+1 by the missing
+        cut-width invariant), or -1 when not in missing mode — the ONE
+        definition every binning/descend site shares."""
+        return (int(self.cuts.shape[1]) + 1) if self._missing else -1
+
+    def _bin_matrix(self, x) -> jax.Array:
+        """Digitize against the model's cuts, honoring missing mode
+        (NaN → reserved bin ``n_bins-1``)."""
+        if self._missing:
+            return apply_bins_missing(x, self.cuts, self._miss_bin())
+        return apply_bins(x, self.cuts)
+
+    def _check_nan_allowed(self, X: np.ndarray, where: str) -> None:
+        """A non-missing model given NaN must fail loudly — plain
+        searchsorted would silently alias NaN into the top value bin."""
+        if not self._missing and np.isnan(X).any():
+            log_fatal(f"{where}: X contains NaN but this model was "
+                      f"trained without missing support (train with NaN "
+                      f"present to enable the learned default "
+                      f"direction, or impute)")
+
     def _pad_rows(self, X, y, weight):
         """Pad rows to a mesh-size multiple and build the weight mask
         (pad rows weigh 0, so they are invisible to cuts/grads/hists)."""
@@ -1003,6 +1091,41 @@ class HistGBT:
         y = np.ascontiguousarray(y, dtype=np.float32)
         n, F = X.shape
         CHECK_EQ(len(y), n, "X/y row mismatch")
+        # NaN = missing (XGBoost semantics): auto-enter missing mode on
+        # first sight of NaN.  Sticky: once a model has missing-mode
+        # cuts/trees, later NaN-free batches still bin in missing mode;
+        # the reverse (NaN arriving at a non-missing model with cuts
+        # already frozen) must fail loudly, not silently alias NaN into
+        # the top value bin.
+        has_nan = bool(np.isnan(X).any())
+        from dmlc_core_tpu.parallel import collectives as coll
+        if coll.world_size() > 1:
+            # mode selection must be GLOBAL: a shard that happens to hold
+            # no NaN rows would otherwise build differently-shaped cut
+            # summaries (allgather shape mismatch) and a different round
+            # program than its peers (histogram psum divergence)
+            has_nan = bool(coll.allreduce(
+                np.asarray([has_nan], np.int32), op="max")[0])
+        if has_nan and self.cuts is None and cuts is None:
+            CHECK(p.n_bins >= 3,
+                  "NaN features need n_bins >= 3 (one bin is reserved "
+                  "for missing)")
+            finite_any = np.isfinite(X).any(axis=0)
+            if coll.world_size() > 1:
+                # per-feature finiteness must be judged globally too: a
+                # shard whose rows happen to be all-NaN for one feature
+                # must not fatal (false positive) while its peers walk
+                # into the cut allgather without it
+                finite_any = coll.allreduce(
+                    finite_any.astype(np.int32), op="max").astype(bool)
+            CHECK(finite_any.all(),
+                  "a feature is all-NaN: drop it or impute")
+            self._missing = True
+        else:
+            CHECK(not has_nan or self._missing,
+                  "X contains NaN but this model's bins were built "
+                  "without a missing bin — refit from scratch (NaN in "
+                  "the first fit enables missing support) or impute")
         # explicit cuts always win (a caller injecting boundaries must
         # not be silently overridden by leftovers from an earlier or
         # failed fit); existing self.cuts are kept only when nothing is
@@ -1010,9 +1133,22 @@ class HistGBT:
         if cuts is not None:
             self.cuts = cuts
         elif self.cuts is None:
+            # missing mode: n_bins-1 VALUE bins (cuts [F, n_bins-2]),
+            # bin n_bins-1 reserved for NaN
             self.cuts = compute_cuts(
-                X, p.n_bins, weight=weight,
-                allgather_fn=self._maybe_allgather())
+                X, p.n_bins - 1 if self._missing else p.n_bins,
+                weight=weight,
+                allgather_fn=self._maybe_allgather(),
+                missing=self._missing)
+        # cut width is the mode's load-bearing invariant: a mismatch
+        # (e.g. standard-shaped cuts= injected into a missing-mode
+        # model) would silently shift the reserved NaN bin out of the
+        # histogram and misread the top value bin as missing mass
+        CHECK_EQ(int(self.cuts.shape[1]),
+                 p.n_bins - (2 if self._missing else 1),
+                 f"cuts width must be n_bins-{2 if self._missing else 1} "
+                 f"for this model "
+                 f"({'missing' if self._missing else 'standard'} mode)")
         X, y, mask, n_pad = self._pad_rows(X, y, weight)
 
         row_sharding = NamedSharding(self.mesh, P("data"))
@@ -1027,10 +1163,11 @@ class HistGBT:
         # device path.
         if _host_bin_requested():
             bins_t = jax.device_put(
-                _host_bin_t(X, np.asarray(self.cuts)),
+                _host_bin_t(X, np.asarray(self.cuts),
+                            missing=self._missing),
                 NamedSharding(self.mesh, P(None, "data")))
         else:
-            bins = apply_bins(jax.device_put(X, mat_sharding), self.cuts)
+            bins = self._bin_matrix(jax.device_put(X, mat_sharding))
             # the round program wants bins FEATURE-major ([F, n], rows on
             # lanes): the Pallas histogram kernel then reads its native
             # layout directly instead of re-transposing the matrix inside
@@ -1155,6 +1292,12 @@ class HistGBT:
         CHECK(p.objective != "rank:pairwise",
               "fit_external: rank:pairwise needs the grouped in-core "
               "layout — use fit(X, y, qid=...)")
+        CHECK(not self._missing,
+              "fit_external: this model was trained in missing mode "
+              "(NaN bin + learned directions); the streaming engine "
+              "builds standard cuts and would silently misread the top "
+              "value bin as missing mass — continue with fit(), or use "
+              "a fresh model")
         B = p.n_bins
 
         # -- pass 1: streaming sketch --------------------------------------
@@ -1191,6 +1334,13 @@ class HistGBT:
         cuts_for_bin = np.asarray(self.cuts) if host_bin else None
         for block in row_iter:
             X = block.to_dense(F)
+            # in pass 2 so it runs on the explicit-cuts path too (pass 1
+            # is skipped there): plain searchsorted would silently alias
+            # NaN into the top value bin
+            CHECK(not np.isnan(X).any(),
+                  "fit_external: NaN features are only supported by "
+                  "the in-core fit (learned missing direction) — "
+                  "impute before streaming, or fit in-core")
             if host_bin:
                 bins = _host_bin_t(X, cuts_for_bin)
             else:
@@ -1606,7 +1756,7 @@ class HistGBT:
         return (self.mesh, n_features, n_rounds, p.max_depth, p.n_bins,
                 p.learning_rate, p.reg_lambda, p.gamma, p.min_child_weight,
                 p.hist_method, obj_key, mono, p.subsample,
-                p.colsample_bytree, p.num_class,
+                p.colsample_bytree, p.num_class, self._missing,
                 os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"))
 
     def _build_round_fn(self, n_features: int, n_rounds: int = 1):
@@ -1635,10 +1785,18 @@ class HistGBT:
                             np.int32)
             if np.any(mc):
                 mono_arr = mc
-        best_split = _make_best_split(B, lam, gamma, mcw, mono=mono_arr)
+        missing = self._missing
+        if missing:
+            CHECK(mono_arr is None,
+                  "monotone_constraints with NaN features is not "
+                  "supported (learned missing direction would need "
+                  "direction-aware bound propagation) — impute missing "
+                  "values or drop the constraints")
+        best_split = _make_best_split(B, lam, gamma, mcw, mono=mono_arr,
+                                      missing=missing)
         best_split_leaf = _make_best_split(B, lam, gamma, mcw,
                                            with_child_sums=True,
-                                           mono=mono_arr)
+                                           mono=mono_arr, missing=missing)
         # snapshot EVERY param the traced closure reads: the program is
         # cached process-wide under the key above, and a later retrace
         # (new input shape) must not see live mutations of some other
@@ -1704,9 +1862,10 @@ class HistGBT:
             feats = []
             thrs = []
             gains = []
+            dirs = []                                # missing mode only
             gsum = hsum = None
             prev_hist = None
-            feat = thr = None
+            feat = thr = dirv = None
             bounds = None
             if mono_arr is not None:
                 bounds = jnp.stack([jnp.full(1, -jnp.inf, jnp.float32),
@@ -1721,25 +1880,37 @@ class HistGBT:
                     n_prev = n_nodes >> 1
                     feat_sel = table_select(feat, node, n_prev)       # [n]
                     thr_sel = table_select(thr, node, n_prev)         # [n]
+                    dir_sel = (table_select(dirv, node, n_prev)
+                               if missing else None)
                     left, node = fused_descend_histogram(
                         bins_tl, node, feat_sel, thr_sel, g, h,
-                        n_prev, B, method, fuse=fuse_levels)
+                        n_prev, B, method, fuse=fuse_levels,
+                        dir_sel=dir_sel,
+                        miss_bin=B - 1 if missing else None)
                     left = jax.lax.psum(left, "data")
                     right = prev_hist - left
                     hist = jnp.stack([left, right], axis=2).reshape(
                         2, n_nodes, left.shape[2], B)
                 prev_hist = hist
                 if mono_arr is not None or level == depth - 1:
-                    feat, thr, gn, cg_, ch_ = best_split_leaf(
-                        hist, feat_mask, bounds)
+                    if missing:
+                        feat, thr, dirv, gn, cg_, ch_ = best_split_leaf(
+                            hist, feat_mask, bounds)
+                    else:
+                        feat, thr, gn, cg_, ch_ = best_split_leaf(
+                            hist, feat_mask, bounds)
                     if level == depth - 1:
                         gsum, hsum = cg_, ch_
+                elif missing:
+                    feat, thr, dirv, gn = best_split(hist, feat_mask)
                 else:
                     feat, thr, gn = best_split(hist, feat_mask)
                 # pad per-level arrays to a common width for stacking
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
                 gains.append(jnp.pad(gn, (0, half - n_nodes)))
+                if missing:
+                    dirs.append(jnp.pad(dirv, (0, half - n_nodes)))
                 if mono_arr is not None:
                     lo, hi = bounds[:, 0], bounds[:, 1]               # [N]
                     w_child = jnp.clip(
@@ -1765,7 +1936,12 @@ class HistGBT:
             feat_sel = table_select(feat, node, 1 << (depth - 1))
             thr_sel = table_select(thr, node, 1 << (depth - 1))
             row_bin = select_feature_bins(bins_tl, feat_sel)          # [n]
-            node = 2 * node + (row_bin > thr_sel).astype(jnp.int32)
+            go_right = row_bin > thr_sel
+            if missing:
+                dir_sel = table_select(dirv, node, 1 << (depth - 1))
+                go_right = jnp.where(row_bin == B - 1, dir_sel == 0,
+                                     go_right)
+            node = 2 * node + go_right.astype(jnp.int32)
             leaf_w = -gsum / (hsum + lam)
             if mono_arr is not None:
                 leaf_w = jnp.clip(leaf_w, bounds[:, 0], bounds[:, 1])
@@ -1776,6 +1952,8 @@ class HistGBT:
                 "gain": jnp.stack(gains),                # [depth, half]
                 "leaf": leaf,                            # [n_leaf]
             }
+            if missing:
+                tree["dir"] = jnp.stack(dirs)            # [depth, half]
             return tree, table_select(leaf, node, n_leaf)
 
         n_class = p.num_class
@@ -1808,8 +1986,10 @@ class HistGBT:
                     bins_tl, g_all[:, c], h_all[:, c], feat_mask)
                 class_trees.append(tree_c)
                 deltas.append(delta_c)
+            tree_keys = ("feat", "thr", "gain", "leaf") + (
+                ("dir",) if missing else ())
             tree = {key_: jnp.stack([t[key_] for t in class_trees])
-                    for key_ in ("feat", "thr", "gain", "leaf")}  # [K, ...]
+                    for key_ in tree_keys}                    # [K, ...]
             return preds_l + jnp.stack(deltas, axis=1), tree
 
         preds_spec = P("data", None) if n_class > 1 else P("data")
@@ -1871,12 +2051,13 @@ class HistGBT:
         path uploads the model once."""
         p = self.param
         X = np.ascontiguousarray(X, dtype=np.float32)
+        self._check_nan_allowed(X, "predict")
         if len(X) == 0:
             return np.zeros(self._margin_shape(0), np.float32)
         outs = []
         for lo in range(0, len(X), self._PREDICT_BATCH):
             xb = X[lo:lo + self._PREDICT_BATCH]
-            bins = apply_bins(jnp.asarray(xb), self.cuts)
+            bins = self._bin_matrix(jnp.asarray(xb))
             margin = self._apply_trees(
                 bins, stacked,
                 jnp.full(self._margin_shape(len(xb)), p.base_score,
@@ -1935,23 +2116,29 @@ class HistGBT:
         use = self._resolve_trees(n_trees)
         stacked = self._stacked_trees(use)
         X = np.ascontiguousarray(X, dtype=np.float32)
+        self._check_nan_allowed(X, "predict_leaf")
         if len(X) == 0:
             shape = ((0, len(use), self.param.num_class)
                      if self.param.num_class > 1 else (0, len(use)))
             return np.zeros(shape, np.int32)
+        miss = self._miss_bin()
+        dirs = stacked.get("dir")
         outs = []
         for lo in range(0, len(X), self._PREDICT_BATCH):
-            bins = apply_bins(jnp.asarray(X[lo:lo + self._PREDICT_BATCH]),
-                              self.cuts)
+            bins = self._bin_matrix(
+                jnp.asarray(X[lo:lo + self._PREDICT_BATCH]))
             if stacked["feat"].ndim == 4:   # multiclass [T, K, depth, half]
-                cols = [_leaf_indices(bins, stacked["feat"][:, c],
-                                      stacked["thr"][:, c], depth)
+                cols = [_leaf_indices(
+                            bins, stacked["feat"][:, c],
+                            stacked["thr"][:, c], depth,
+                            dirs[:, c] if dirs is not None else None,
+                            miss)
                         for c in range(stacked["feat"].shape[1])]
                 outs.append(np.stack([np.asarray(c) for c in cols], axis=2))
             else:
                 outs.append(np.asarray(
                     _leaf_indices(bins, stacked["feat"], stacked["thr"],
-                                  depth)))
+                                  depth, dirs, miss)))
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def predict_proba(self, X: np.ndarray,
@@ -1996,23 +2183,30 @@ class HistGBT:
 
     @staticmethod
     def _stacked_trees(trees: List[Dict[str, np.ndarray]]) -> Dict[str, jax.Array]:
+        keys = ("feat", "thr", "leaf") + (
+            ("dir",) if "dir" in trees[0] else ())
         return {k: jnp.asarray(np.stack([t[k] for t in trees]))
-                for k in ("feat", "thr", "leaf")}
+                for k in keys}
 
     def _apply_trees(self, bins, stacked, init):
         """Add the stacked trees' margins onto ``init`` ([n] or [n, K])."""
         depth = self.param.max_depth
+        miss = self._miss_bin()
+        dirs = stacked.get("dir")
         if stacked["feat"].ndim == 4:      # multiclass: [T, K, depth, half]
             cols = [
                 _predict_trees(bins, stacked["feat"][:, c],
                                stacked["thr"][:, c],
                                stacked["leaf"][:, c], depth, 0.0,
-                               init[:, c])
+                               init[:, c],
+                               dirs[:, c] if dirs is not None else None,
+                               miss)
                 for c in range(stacked["feat"].shape[1])
             ]
             return jnp.stack(cols, axis=1)
         return _predict_trees(bins, stacked["feat"], stacked["thr"],
-                              stacked["leaf"], depth, 0.0, init)
+                              stacked["leaf"], depth, 0.0, init,
+                              dirs, miss)
 
     # ------------------------------------------------------------------
     # persistence & introspection
@@ -2041,6 +2235,7 @@ class HistGBT:
                 "best_iteration": self.best_iteration,
                 "best_score": self.best_score,
                 "early_stopped": getattr(self, "_early_stopped", False),
+                "missing": self._missing,
             })
         finally:
             s.close()
@@ -2070,6 +2265,7 @@ class HistGBT:
         model.best_iteration = payload.get("best_iteration")
         model.best_score = payload.get("best_score")
         model._early_stopped = payload.get("early_stopped", False)
+        model._missing = payload.get("missing", False)
         return model
 
     def dump_model(self, with_stats: bool = False) -> str:
@@ -2090,10 +2286,11 @@ class HistGBT:
         B = self.param.n_bins
         lines: List[str] = []
 
-        def dump_one(feat_t, thr_t, gain_t, leaf_t):
+        def dump_one(feat_t, thr_t, gain_t, leaf_t, dir_t=None):
             feat_t = np.asarray(feat_t)
             thr_t = np.asarray(thr_t)
             gain_t = None if gain_t is None else np.asarray(gain_t)
+            dir_t = None if dir_t is None else np.asarray(dir_t)
             n_levels = feat_t.shape[0]
             for level in range(n_levels):
                 n_nodes = 1 << level
@@ -2106,12 +2303,20 @@ class HistGBT:
                         lines.append(f"\t{gid}:passthrough "
                                      f"yes={kid},no={kid + 1}")
                         continue
+                    miss = ""
+                    if dir_t is not None:     # XGBoost's missing= target
+                        d = int(dir_t[level][nid])
+                        miss = f",missing={kid if d == 1 else kid + 1}"
                     stat = ""
                     if with_stats and gain_t is not None:
                         stat = f",gain={float(gain_t[level][nid]):.6g}"
+                    # missing mode's top value threshold (t == #cuts) is
+                    # a missingness-only split: every finite value left
+                    cond = (f"f{f}<{cuts[f][t]:.6g}"
+                            if t < cuts.shape[1] else f"f{f}<inf")
                     lines.append(
-                        f"\t{gid}:[f{f}<{cuts[f][t]:.6g}] "
-                        f"yes={kid},no={kid + 1}{stat}")
+                        f"\t{gid}:[{cond}] "
+                        f"yes={kid},no={kid + 1}{miss}{stat}")
             base = (1 << n_levels) - 1
             for i, v in enumerate(np.asarray(leaf_t)):
                 lines.append(f"\t{base + i}:leaf={float(v):.6g}")
@@ -2123,11 +2328,12 @@ class HistGBT:
                     lines.append(f"booster[{ti}] class[{c}]:")
                     dump_one(tree["feat"][c], tree["thr"][c],
                              tree["gain"][c] if "gain" in tree else None,
-                             tree["leaf"][c])
+                             tree["leaf"][c],
+                             tree["dir"][c] if "dir" in tree else None)
             else:
                 lines.append(f"booster[{ti}]:")
                 dump_one(tree["feat"], tree["thr"], tree.get("gain"),
-                         tree["leaf"])
+                         tree["leaf"], tree.get("dir"))
         return "\n".join(lines) + "\n"
 
     def feature_importances(self, importance_type: str = "weight"
@@ -2174,46 +2380,63 @@ class HistGBT:
         return out
 
 
-@partial(jax.jit, static_argnums=(4,))
+def _descend_step(bins, feat, thr, dirv, node, miss_bin):
+    """One level of tree descent shared by the predict programs: select
+    the node's feature bin and route right on bin > thr, with missing
+    rows (bin == miss_bin; only produced in missing mode) following the
+    node's learned direction (1 = left)."""
+    f = feat[node]
+    t = thr[node]
+    row_bin = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+    go_right = row_bin > t
+    if dirv is not None:
+        d = dirv[node]
+        go_right = jnp.where(row_bin == miss_bin, d == 0, go_right)
+    return 2 * node + go_right.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(4, 8))
 def _predict_trees(bins, feats, thrs, leaves, depth: int,
-                   base_score: float = 0.0, init=None):
+                   base_score: float = 0.0, init=None,
+                   dirs=None, miss_bin: int = -1):
     """Sum leaf values over trees: scan over trees, unrolled descent.
 
     ``init`` carries margins from already-applied trees (the incremental
     validation path); otherwise margins start at ``base_score``.
+    ``dirs``/``miss_bin`` enable missing-mode routing (see
+    :func:`_descend_step`).
     """
 
     def one_tree(carry, tree):
-        feat, thr, leaf = tree
+        feat, thr, dirv, leaf = tree
         node = jnp.zeros(bins.shape[0], jnp.int32)
         for _level in range(depth):
-            f = feat[_level][node]
-            t = thr[_level][node]
-            row_bin = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
-            node = 2 * node + (row_bin > t).astype(jnp.int32)
+            node = _descend_step(
+                bins, feat[_level], thr[_level],
+                None if dirv is None else dirv[_level], node, miss_bin)
         return carry + leaf[node], None
 
     if init is None:
         init = jnp.full(bins.shape[0], base_score, jnp.float32)
-    total, _ = jax.lax.scan(one_tree, init, (feats, thrs, leaves))
+    total, _ = jax.lax.scan(one_tree, init, (feats, thrs, dirs, leaves))
     return total
 
 
-@partial(jax.jit, static_argnums=(3,))
-def _leaf_indices(bins, feats, thrs, depth: int):
+@partial(jax.jit, static_argnums=(3, 5))
+def _leaf_indices(bins, feats, thrs, depth: int, dirs=None,
+                  miss_bin: int = -1):
     """Per-tree leaf assignment [n, T] (predict_leaf); same unrolled
     descent as _predict_trees, collecting the final node instead of
     summing leaf values."""
 
     def one_tree(_, tree):
-        feat, thr = tree
+        feat, thr, dirv = tree
         node = jnp.zeros(bins.shape[0], jnp.int32)
         for _level in range(depth):
-            f = feat[_level][node]
-            t = thr[_level][node]
-            row_bin = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
-            node = 2 * node + (row_bin > t).astype(jnp.int32)
+            node = _descend_step(
+                bins, feat[_level], thr[_level],
+                None if dirv is None else dirv[_level], node, miss_bin)
         return 0, node
 
-    _, nodes = jax.lax.scan(one_tree, 0, (feats, thrs))   # [T, n]
+    _, nodes = jax.lax.scan(one_tree, 0, (feats, thrs, dirs))   # [T, n]
     return nodes.T

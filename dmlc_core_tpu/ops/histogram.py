@@ -481,6 +481,8 @@ def fused_descend_histogram(
     n_bins: int,
     method: str = "auto",
     fuse: bool = False,
+    dir_sel: jax.Array = None,  # [n] learned missing direction (1=left)
+    miss_bin: int = None,       # bin index reserved for NaN rows
 ):
     """Advance rows one level down the tree and build the new level's
     LEFT-child histograms.  Returns ``(left_hist, new_node)`` with
@@ -497,7 +499,8 @@ def fused_descend_histogram(
     rate, binds."""
     F = bins_t.shape[0]
     itemsize = jnp.dtype(bins_t.dtype).itemsize
-    use_pallas = (fuse and method in ("auto", "pallas")
+    use_pallas = (fuse and dir_sel is None
+                  and method in ("auto", "pallas")
                   and jax.default_backend() == "tpu"
                   and _pallas_ok(n_bins, F, n_prev, itemsize))
     if use_pallas:
@@ -506,7 +509,12 @@ def fused_descend_histogram(
     # unfused fallback: XLA descend, then the regular histogram
     valid = node_id >= 0
     row_bin = select_feature_bins(bins_t, feat_sel)
-    new_node = jnp.where(valid, 2 * node_id + (row_bin > thr_sel), -1)
+    go_right = row_bin > thr_sel
+    if dir_sel is not None:
+        # learned missing direction: NaN rows (bin == miss_bin) follow
+        # their node's dir bit (1 = left) instead of the threshold
+        go_right = jnp.where(row_bin == miss_bin, dir_sel == 0, go_right)
+    new_node = jnp.where(valid, 2 * node_id + go_right, -1)
     node_h = jnp.where(valid & (new_node % 2 == 0), new_node >> 1, -1)
     hist = build_histogram(bins_t, node_h, grad, hess, n_prev, n_bins,
                            method, transposed=True)

@@ -50,23 +50,40 @@ from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.base.parameter import get_env
 
 __all__ = ["local_summary", "merge_summaries", "compute_cuts", "apply_bins",
-           "SketchAccumulator"]
+           "apply_bins_missing", "SketchAccumulator"]
 
 
-@partial(jax.jit, static_argnums=(2,))
-def local_summary(x: jax.Array, weight: Optional[jax.Array], n_summary: int) -> jax.Array:
+@partial(jax.jit, static_argnums=(2, 3))
+def local_summary(x: jax.Array, weight: Optional[jax.Array],
+                  n_summary: int, missing: bool = False) -> jax.Array:
     """Fixed-size weighted quantile summary of local rows.
 
     ``x``: [n, F] f32; ``weight``: [n] or None.  Returns [F, n_summary]
     (per-feature weighted quantiles on an even probability grid).
+
+    ``missing=True``: NaN entries are excluded from the summary by
+    rewriting them to the feature's max finite value with weight 0 —
+    a zero-weight duplicate knot that cannot move any quantile (the
+    fixed-shape alternative to per-feature nan-filtering, which would
+    break the [F, n_summary] contract when NaN counts differ by
+    feature).  Callers must reject all-NaN features (the max is -inf).
     """
     n, F = x.shape
     qs = jnp.linspace(0.0, 1.0, n_summary)
-    if weight is None:
+    if missing:
+        nan = jnp.isnan(x)
+        w2d = (jnp.ones_like(x) if weight is None
+               else jnp.broadcast_to(weight[:, None], x.shape))
+        w2d = jnp.where(nan, 0.0, w2d)
+        fmax = jnp.max(jnp.where(nan, -jnp.inf, x), axis=0)    # [F]
+        x = jnp.where(nan, fmax[None, :], x)
+    elif weight is None:
         return jnp.quantile(x, qs, axis=0).T  # [F, n_summary]
+    else:
+        w2d = jnp.broadcast_to(weight[:, None], x.shape)
     order = jnp.argsort(x, axis=0)                                    # [n, F]
     xs = jnp.take_along_axis(x, order, axis=0)
-    ws = weight[order]                                                # [n, F]
+    ws = jnp.take_along_axis(w2d, order, axis=0)                      # [n, F]
     cw = jnp.cumsum(ws, axis=0)
     total = cw[-1:, :]
     probs = (cw - 0.5 * ws) / total                                   # midpoint rule
@@ -96,17 +113,22 @@ def compute_cuts(
     weight: Optional[np.ndarray] = None,
     n_summary: Optional[int] = None,
     allgather_fn=None,
+    missing: bool = False,
 ) -> jax.Array:
     """End-to-end cut computation.
 
     ``allgather_fn(summary) -> [W, F, S]`` injects the distributed gather
     (e.g. ``collectives.allgather`` across processes, or an in-mesh
     all_gather); None means single worker.
+
+    ``missing=True`` computes cuts over finite values only (NaN = missing;
+    see :func:`local_summary`); callers reserve a bin for NaN separately
+    (:func:`apply_bins` with ``missing=True``).
     """
     CHECK(n_bins >= 2, "need at least 2 bins")
     n_summary = n_summary or max(8 * n_bins, 64)
     summary = local_summary(jnp.asarray(x), None if weight is None else jnp.asarray(weight),
-                            n_summary)
+                            n_summary, missing)
     if allgather_fn is not None:
         gathered = jnp.asarray(allgather_fn(np.asarray(summary)))
     else:
@@ -263,4 +285,21 @@ def apply_bins(x: jax.Array, cuts: jax.Array) -> jax.Array:
         in_axes=(1, 0), out_axes=1,
     )(x, cuts)
     dtype = jnp.uint8 if cuts.shape[1] < 256 else jnp.int32
+    return out.astype(dtype)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def apply_bins_missing(x: jax.Array, cuts: jax.Array,
+                       miss_bin: int) -> jax.Array:
+    """:func:`apply_bins` with a reserved NaN bin: finite values digitize
+    into ``[0, n_cuts]`` as usual and NaN maps to ``miss_bin`` (the
+    caller reserves its top bin — searchsorted alone would silently
+    alias NaN with the top VALUE bin, scoring garbage).
+    """
+    out = jax.vmap(
+        lambda col, c: jnp.searchsorted(c, col, side="right"),
+        in_axes=(1, 0), out_axes=1,
+    )(x, cuts)
+    out = jnp.where(jnp.isnan(x), miss_bin, out)
+    dtype = jnp.uint8 if miss_bin < 256 else jnp.int32
     return out.astype(dtype)
