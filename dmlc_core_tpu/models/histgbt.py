@@ -635,6 +635,11 @@ class HistGBTParam(Parameter):
     num_class = field(int, default=1, lower_bound=1,
                       description="classes for multi:softmax")
     base_score = field(float, default=0.0, description="initial raw margin")
+    scale_pos_weight = field(float, default=1.0, lower_bound=0.0,
+                             description="binary:logistic — weight "
+                                         "multiplier for positive rows "
+                                         "(imbalanced data; typical "
+                                         "value: #neg/#pos)")
     subsample = field(float, default=1.0, lower_bound=0.0, upper_bound=1.0,
                       description="per-round row subsampling rate")
     colsample_bytree = field(float, default=1.0, lower_bound=0.0,
@@ -790,6 +795,7 @@ class HistGBT:
         if continuing:
             CHECK(self.cuts is not None, "continue-fit without cuts")
             self._check_nan_allowed(X, "fit (continued)")
+            weight = self._fold_scale_pos_weight(y, weight)
             X, y, mask, n_pad = self._pad_rows(X, y, weight)
             # the warm-start branch needs the row-major f32 upload anyway
             # (margin replay reads it), so it always bins on device
@@ -1027,6 +1033,28 @@ class HistGBT:
         definition every binning/descend site shares."""
         return (int(self.cuts.shape[1]) + 1) if self._missing else -1
 
+    def _fold_scale_pos_weight(self, y, weight):
+        """Fold ``scale_pos_weight`` into the instance-weight vector.
+
+        XGBoost semantics: positives' grad AND hess scale by the factor
+        — definitionally an instance weight.  THE one implementation,
+        called by every data entry point (make_device_data → fit fresh
+        + fit_device, fit's continue branch, fit_external's sketch AND
+        page passes) so no path can silently drop the knob, and the
+        scaling flows into the quantile sketch's weighting exactly like
+        an explicit weight vector would.
+        """
+        p = self.param
+        if p.scale_pos_weight == 1.0:
+            return weight
+        CHECK(p.objective == "binary:logistic",
+              f"scale_pos_weight only applies to binary:logistic "
+              f"(objective is {p.objective!r})")
+        spw = np.where(np.asarray(y) == 1.0,
+                       np.float32(p.scale_pos_weight), np.float32(1.0))
+        return spw if weight is None else np.asarray(
+            weight, np.float32) * spw
+
     def _bin_matrix(self, x) -> jax.Array:
         """Digitize against the model's cuts, honoring missing mode
         (NaN → reserved bin ``n_bins-1``)."""
@@ -1091,6 +1119,7 @@ class HistGBT:
         y = np.ascontiguousarray(y, dtype=np.float32)
         n, F = X.shape
         CHECK_EQ(len(y), n, "X/y row mismatch")
+        weight = self._fold_scale_pos_weight(y, weight)
         # NaN = missing (XGBoost semantics): auto-enter missing mode on
         # first sight of NaN.  Sticky: once a model has missing-mode
         # cuts/trees, later NaN-free batches still bin in missing mode;
@@ -1298,6 +1327,11 @@ class HistGBT:
               "builds standard cuts and would silently misread the top "
               "value bin as missing mass — continue with fit(), or use "
               "a fresh model")
+        if p.scale_pos_weight != 1.0:
+            # fail BEFORE the full-dataset sketch pass, not per page
+            CHECK(p.objective == "binary:logistic",
+                  f"scale_pos_weight only applies to binary:logistic "
+                  f"(objective is {p.objective!r})")
         B = p.n_bins
 
         # -- pass 1: streaming sketch --------------------------------------
@@ -1317,7 +1351,10 @@ class HistGBT:
                 if sketch is None:
                     sketch = SketchAccumulator(F, n_summary=max(8 * B, 64),
                                                buffer_pages=sketch_pages)
-                sketch.add(X, block.weight)
+                # scaled weights here too: the cuts an explicit weight
+                # vector would produce and the spw cuts must match
+                sketch.add(X, self._fold_scale_pos_weight(
+                    block.label, block.weight))
             CHECK(sketch is not None, "fit_external: empty input")
             self.cuts = sketch.finalize(B, allgather_fn=self._maybe_allgather())
 
@@ -1350,6 +1387,8 @@ class HistGBT:
                                              # device at a time (out-of-core)
             w = (np.asarray(block.weight, np.float32)
                  if block.weight is not None else np.ones(len(X), np.float32))
+            w = self._fold_scale_pos_weight(
+                np.asarray(block.label, np.float32), w)
             pages.append({
                 "bins": bins,
                 "y": np.asarray(block.label, np.float32),
