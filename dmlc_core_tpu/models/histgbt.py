@@ -797,9 +797,21 @@ class HistGBT:
             self._check_nan_allowed(X, "fit (continued)")
             weight = self._fold_scale_pos_weight(y, weight)
             X, y, mask, n_pad = self._pad_rows(X, y, weight)
-            # the warm-start branch needs the row-major f32 upload anyway
-            # (margin replay reads it), so it always bins on device
-            bins = self._bin_matrix(jax.device_put(X, mat_sharding))
+            # the warm-start branch needs row-major bins for the margin
+            # replay, binned on device — except missing mode over a
+            # process-spanning mesh, which must host-bin (NaN f32
+            # cannot cross the multi-process device_put assert)
+            if self._missing and self._mesh_spans_processes():
+                # NaN f32 can't cross the multi-process device_put
+                # equality assert (NaN != NaN) — ship NaN-free uint8
+                # bins instead (see make_device_data)
+                bins = jax.device_put(
+                    np.ascontiguousarray(
+                        _host_bin_t(X, np.asarray(self.cuts),
+                                    missing=True).T),
+                    mat_sharding)
+            else:
+                bins = self._bin_matrix(jax.device_put(X, mat_sharding))
             bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
             y_d = jax.device_put(y, row_sharding)
             w_d = jax.device_put(mask, row_sharding)
@@ -1027,6 +1039,16 @@ class HistGBT:
             return coll.allgather
         return None
 
+    def _mesh_spans_processes(self) -> bool:
+        """True when this model's mesh holds devices of other processes
+        — the case where device_put of host data is a cross-process
+        collective with jax's global-array equality assert."""
+        import jax as _jax
+
+        pid = _jax.process_index()
+        return any(d.process_index != pid
+                   for d in np.asarray(self.mesh.devices).flat)
+
     def _miss_bin(self) -> int:
         """The reserved NaN bin (``n_bins-1``; = #cuts+1 by the missing
         cut-width invariant), or -1 when not in missing mode — the ONE
@@ -1190,7 +1212,14 @@ class HistGBT:
         # outweighs the transfer saving HERE, so the knob stays opt-in
         # for hosts with cores or slower links; default (unset) is the
         # device path.
-        if _host_bin_requested():
+        if _host_bin_requested() or (self._missing
+                                     and self._mesh_spans_processes()):
+            # missing + process-spanning mesh ALWAYS bins on host:
+            # jax's cross-process device_put consistency assert
+            # compares the global array with == and NaN != NaN, so an
+            # (identical) NaN-bearing f32 X trips it — the uint8 bin
+            # matrix is NaN-free (and 4x smaller to ship).  A local
+            # mesh inside a multi-process job keeps the device path.
             bins_t = jax.device_put(
                 _host_bin_t(X, np.asarray(self.cuts),
                             missing=self._missing),

@@ -543,6 +543,71 @@ class TestMultiProcessLocal:
         tracker_submit(2, 0, fun_submit, host_ip="127.0.0.1")
         assert codes == [0, 0]
 
+    def test_local_launch_histgbt_missing_mode(self, tmp_path):
+        """Missing-value training across real processes: NaN rows all
+        land in rank 0's addressable shard, so rank 1 sees no local NaN
+        on device — mode selection (allreduce-OR of NaN presence), the
+        missing-aware cut allgather (fixed-shape zero-weight NaN knots),
+        the missing-bin histogram psum, and per-node direction choice
+        must all agree across the cluster, and both ranks must learn
+        the MNAR signal (only recoverable via the learned direction)."""
+        script = tmp_path / "gbt_missing_worker.py"
+        script.write_text(textwrap.dedent(
+            """
+            from dmlc_core_tpu.utils import force_cpu_devices
+            force_cpu_devices(1)
+            import numpy as np
+            from dmlc_core_tpu.parallel import collectives as coll
+            coll.init()
+            import jax
+            from jax.sharding import Mesh
+            from dmlc_core_tpu.models import HistGBT
+
+            r, w = coll.rank(), coll.world_size()
+            assert w == 2, w
+            rng = np.random.default_rng(7)
+            X = rng.normal(size=(512, 6)).astype(np.float32)
+            y = (X[:, 0] > 0).astype(np.float32)
+            # MNAR mask confined to the FIRST half = rank 0's shard
+            Xm = X.copy()
+            mask = np.zeros(512, bool)
+            mask[:256] = X[:256, 0] > 0
+            Xm[mask, 0] = np.nan
+
+            kw = dict(n_trees=6, max_depth=3, n_bins=32,
+                      learning_rate=0.5)
+            dist = HistGBT(mesh=Mesh(np.array(jax.devices()),
+                                     ("data",)), **kw)
+            dist.fit(Xm, y)
+            assert dist._missing, "mode must be ON on every rank"
+            local = HistGBT(
+                mesh=Mesh(np.array(jax.local_devices()), ("data",)),
+                **kw)
+            local.fit(Xm, y)
+            for i, (td, tl) in enumerate(zip(dist.trees, local.trees)):
+                assert np.array_equal(td["feat"], tl["feat"]), (r, i)
+                assert np.array_equal(td["thr"], tl["thr"]), (r, i)
+                assert np.array_equal(td["dir"], tl["dir"]), (r, i)
+            pred = dist.predict(Xm) > 0.5
+            acc_masked = (pred[mask] == y[mask]).mean()
+            assert acc_masked > 0.9, (r, acc_masked)
+            print(f"worker {r}/{w}: missing-mode parity OK", flush=True)
+            """
+        ))
+        from dmlc_core_tpu.tracker import local as local_backend
+
+        codes = []
+
+        def fun_submit(n, envs):
+            env = dict(envs)
+            env["PYTHONPATH"] = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            codes.extend(local_backend.launch(
+                2, [sys.executable, str(script)], env, timeout=240))
+
+        tracker_submit(2, 0, fun_submit, host_ip="127.0.0.1")
+        assert codes == [0, 0]
+
     def test_local_launch_bert_training_parity(self, tmp_path):
         """A bundled TRANSFORMER trained across real processes: the
         fused in-step grad psum rides the cross-process Gloo backend on
