@@ -282,6 +282,24 @@ def _host_bin_requested() -> bool:
               f"binning) or unset (bin on the data's device) are valid")
 
 
+def fold_scale_pos_weight(param, y, weight):
+    """Fold ``param.scale_pos_weight`` into the instance-weight vector.
+
+    XGBoost semantics: positives' grad AND hess scale by the factor —
+    definitionally an instance weight.  THE one implementation, shared
+    by HistGBT and GBLinear (any booster whose param carries the field
+    and an ``objective``), so the two cannot silently diverge.
+    """
+    if param.scale_pos_weight == 1.0:
+        return weight
+    CHECK(param.objective == "binary:logistic",
+          f"scale_pos_weight only applies to binary:logistic "
+          f"(objective is {param.objective!r})")
+    spw = np.where(np.asarray(y) == 1.0,
+                   np.float32(param.scale_pos_weight), np.float32(1.0))
+    return spw if weight is None else np.asarray(weight, np.float32) * spw
+
+
 def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray,
                 missing: bool = False) -> np.ndarray:
     """Bin ``X`` on the HOST and return the FEATURE-major bin matrix.
@@ -716,6 +734,9 @@ class HistGBT:
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
         self._early_stopped = False
+        #: per-chunk validation curve of the last eval_set fit (see fit)
+        self.eval_history: List[Tuple[int, float]] = []
+        self.eval_metric_name: Optional[str] = None
 
     # ------------------------------------------------------------------
     # training
@@ -859,6 +880,10 @@ class HistGBT:
             metric_fn, maximize = self._obj.metric, False
             metric_name = "loss"
         state = {"best_at": 0, "eval_margin": eval_margin}
+        #: validation curve [(global_round, score)], one point per
+        #: dispatch chunk — the data behind XGBoost's evals_result()
+        self.eval_history: List[Tuple[int, float]] = []
+        self.eval_metric_name = metric_name if eval_set is not None else None
 
         def after_chunk(done, preds_c, trees_k):
             if eval_bins is None:
@@ -866,6 +891,7 @@ class HistGBT:
             state["eval_margin"] = self._apply_trees(
                 eval_bins, trees_k, state["eval_margin"])
             vloss = float(metric_fn(state["eval_margin"], yv_d))
+            self.eval_history.append((n_prior + done, vloss))
             improved = (self.best_score is None
                         or (vloss > self.best_score if maximize
                             else vloss < self.best_score))
@@ -1056,26 +1082,14 @@ class HistGBT:
         return (int(self.cuts.shape[1]) + 1) if self._missing else -1
 
     def _fold_scale_pos_weight(self, y, weight):
-        """Fold ``scale_pos_weight`` into the instance-weight vector.
-
-        XGBoost semantics: positives' grad AND hess scale by the factor
-        — definitionally an instance weight.  THE one implementation,
+        """Fold ``scale_pos_weight`` into the instance-weight vector —
         called by every data entry point (make_device_data → fit fresh
         + fit_device, fit's continue branch, fit_external's sketch AND
         page passes) so no path can silently drop the knob, and the
         scaling flows into the quantile sketch's weighting exactly like
-        an explicit weight vector would.
-        """
-        p = self.param
-        if p.scale_pos_weight == 1.0:
-            return weight
-        CHECK(p.objective == "binary:logistic",
-              f"scale_pos_weight only applies to binary:logistic "
-              f"(objective is {p.objective!r})")
-        spw = np.where(np.asarray(y) == 1.0,
-                       np.float32(p.scale_pos_weight), np.float32(1.0))
-        return spw if weight is None else np.asarray(
-            weight, np.float32) * spw
+        an explicit weight vector would.  Shared with GBLinear via
+        :func:`fold_scale_pos_weight`."""
+        return fold_scale_pos_weight(self.param, y, weight)
 
     def _bin_matrix(self, x) -> jax.Array:
         """Digitize against the model's cuts, honoring missing mode

@@ -83,6 +83,10 @@ class GBLinearParam(Parameter):
                        description="L2 on weights")
     reg_alpha = field(float, default=0.0, lower_bound=0.0,
                       description="L1 on weights (soft-threshold)")
+    scale_pos_weight = field(float, default=1.0, lower_bound=0.0,
+                             description="binary:logistic — weight "
+                                         "multiplier for positive rows "
+                                         "(imbalanced data)")
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror"])
     base_score = field(float, default=0.0)
@@ -201,6 +205,13 @@ class GBLinear:
         return (jnp.bfloat16 if self.param.feature_dtype == "bfloat16"
                 else np.float32)
 
+    def _fold_scale_pos_weight(self, y, weight):
+        """Shared XGBoost scale_pos_weight fold (histgbt's is THE one
+        implementation); called from fit AND fit_iter."""
+        from dmlc_core_tpu.models.histgbt import fold_scale_pos_weight
+
+        return fold_scale_pos_weight(self.param, y, weight)
+
     def fit(self, X: np.ndarray, y: np.ndarray,
             weight: Optional[np.ndarray] = None,
             warmup_rounds: int = 0) -> "GBLinear":
@@ -209,6 +220,7 @@ class GBLinear:
         y = np.ascontiguousarray(y, np.float32)
         n, F = X.shape
         CHECK_EQ(len(y), n, "X/y row mismatch")
+        weight = self._fold_scale_pos_weight(y, weight)
         ndev = self._ndev()
         pad = (-n) % ndev
         mask = np.ones(n + pad, np.float32)
@@ -326,7 +338,7 @@ class GBLinear:
             slab = (xs.astype(dt) if dt is not np.float32 else xs.copy())
             x_d = write(x_d, jnp.asarray(slab), lo)
             y[lo:lo + rows] = ys
-            w[lo:lo + rows] = ws
+            w[lo:lo + rows] = self._fold_scale_pos_weight(ys, ws)
             lo += rows
         CHECK(not (counted and lo == 0),
               "fit_iter: iterator yielded rows in the counting pass but "

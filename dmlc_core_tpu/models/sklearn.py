@@ -147,6 +147,24 @@ class _EstimatorBase(_SkBase):
         CHECK(self._model is not None, "call fit first")
         return self._model
 
+    def evals_result(self) -> Dict[str, Dict[str, list]]:
+        """XGBoost-shaped validation curve of the last ``eval_set`` fit
+        (one point per dispatch chunk — XGBoost records per round; the
+        x-axis is ``[r for r, _ in model.eval_history]``).
+
+        Only the WATCHED pair is tracked (the last of the list form,
+        like XGBoost's early stopping), and its curve is keyed by its
+        position — ``validation_{n-1}`` for an n-pair list — so code
+        expecting XGBoost's per-pair dict fails with a loud KeyError on
+        the untracked pairs instead of silently misreading e.g. the
+        validation curve as the training curve."""
+        m = self.model
+        name = getattr(m, "eval_metric_name", None)
+        CHECK(name is not None,
+              "evals_result: fit with eval_set= first (gbtree only)")
+        key = f"validation_{getattr(self, '_watched_eval_idx', 0)}"
+        return {key: {name: [s for _, s in m.eval_history]}}
+
     @property
     def feature_importances_(self) -> np.ndarray:
         """Normalized gain importances (XGBClassifier's default
@@ -192,8 +210,10 @@ class GBTClassifier(_SkClf, _EstimatorBase):
             # accepted too.  String or non-contiguous labels would
             # otherwise reach the booster raw.
             ev = fit_kw["eval_set"]
+            self._watched_eval_idx = 0
             if isinstance(ev, list):
                 CHECK(len(ev) > 0, "eval_set: empty list")
+                self._watched_eval_idx = len(ev) - 1
                 ev = ev[-1]
             Xv, yv = ev
             yv = np.asarray(yv)

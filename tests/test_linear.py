@@ -168,3 +168,33 @@ class TestGBLinear:
         m.fit(X, yc, warmup_rounds=1)
         assert m.last_chunk_times[-1][0] == 30
         assert m.last_warmup_seconds > 0
+
+
+class TestScalePosWeightLinear:
+    def test_equals_explicit_weights_exactly(self):
+        from dmlc_core_tpu.models.linear import GBLinear
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(1500, 6)).astype(np.float32)
+        y = (X[:, 0] > np.quantile(X[:, 0], 0.9)).astype(np.float32)
+        spw = 9.0
+        a = GBLinear(n_rounds=30, scale_pos_weight=spw)
+        a.fit(X, y)
+        b = GBLinear(n_rounds=30)
+        b.fit(X, y, weight=np.where(y == 1.0, np.float32(spw),
+                                    np.float32(1.0)))
+        np.testing.assert_allclose(a.weights, b.weights, rtol=1e-6)
+        assert a.bias == b.bias
+
+    def test_rejected_for_regression(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.models.linear import GBLinear
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        m = GBLinear(n_rounds=5, objective="reg:squarederror",
+                     scale_pos_weight=2.0)
+        with pytest.raises(Error):
+            m.fit(X, y)

@@ -185,3 +185,39 @@ class TestSklearnComposition:
             {"max_depth": [2, 3]}, cv=2, scoring="accuracy")
         gs.fit(X, y)
         assert gs.best_params_["max_depth"] in (2, 3)
+
+
+class TestEvalsResult:
+    def test_xgboost_shaped_curve(self):
+        X, yb = _cls_data(n=1200)
+        Xv, ybv = _cls_data(n=400, seed=9)
+        # 60 estimators -> 3 dispatch chunks -> a 3-point curve
+        est = GBTClassifier(n_estimators=60, max_depth=3, n_bins=32,
+                            eval_metric="logloss")
+        est.fit(X, yb, eval_set=(Xv, ybv))
+        res = est.evals_result()
+        curve = res["validation_0"]["logloss"]
+        assert len(curve) >= 3
+        # logloss on a learnable problem must improve over the fit
+        assert curve[-1] < curve[0]
+        # x-axis rounds are recorded on the native model
+        rounds = [r for r, _ in est.model.eval_history]
+        assert rounds == sorted(rounds) and rounds[-1] <= 60
+        # XGBoost list form: the WATCHED (last) pair keeps its position
+        # as the key — validation_1 here, and validation_0 is a loud
+        # KeyError rather than silently serving the wrong curve
+        est2 = GBTClassifier(n_estimators=30, max_depth=3, n_bins=32,
+                             eval_metric="logloss")
+        est2.fit(X, yb, eval_set=[(X, yb), (Xv, ybv)])
+        res2 = est2.evals_result()
+        assert list(res2) == ["validation_1"]
+
+    def test_requires_eval_set(self):
+        import pytest
+        from dmlc_core_tpu.base.logging import Error
+
+        X, yb = _cls_data(n=600)
+        est = GBTClassifier(n_estimators=3, max_depth=2, n_bins=16)
+        est.fit(X, yb)
+        with pytest.raises(Error):
+            est.evals_result()
