@@ -2350,7 +2350,8 @@ class HistGBT:
         model._missing = payload.get("missing", False)
         return model
 
-    def dump_model(self, with_stats: bool = False) -> str:
+    def dump_model(self, with_stats: bool = False,
+                   feature_names: Optional[List[str]] = None) -> str:
         """XGBoost-style text dump of the ensemble (``booster[i]:`` per
         tree, one node per line) — the debugging/inspection surface of
         ``Booster.dump_model``.
@@ -2362,9 +2363,16 @@ class HistGBT:
         REAL feature threshold (``cuts[f][thr]`` — bins are internal),
         as ``[f<N>≤x]`` with yes=left.  Degenerate nodes (no profitable
         split: every row goes left) print as ``passthrough``.
-        ``with_stats`` appends each real split's stored gain."""
+        ``with_stats`` appends each real split's stored gain;
+        ``feature_names`` replaces the ``f<N>`` placeholders (XGBoost's
+        fmap role)."""
         CHECK(len(self.trees) > 0, "no trees trained")
         cuts = np.asarray(self.cuts)
+        if feature_names is not None:
+            CHECK_EQ(len(feature_names), cuts.shape[0],
+                     "feature_names length must equal n_features")
+        def fname(f: int) -> str:
+            return feature_names[f] if feature_names is not None else f"f{f}"
         B = self.param.n_bins
         lines: List[str] = []
 
@@ -2394,8 +2402,8 @@ class HistGBT:
                         stat = f",gain={float(gain_t[level][nid]):.6g}"
                     # missing mode's top value threshold (t == #cuts) is
                     # a missingness-only split: every finite value left
-                    cond = (f"f{f}<{cuts[f][t]:.6g}"
-                            if t < cuts.shape[1] else f"f{f}<inf")
+                    cond = (f"{fname(f)}<{cuts[f][t]:.6g}"
+                            if t < cuts.shape[1] else f"{fname(f)}<inf")
                     lines.append(
                         f"\t{gid}:[{cond}] "
                         f"yes={kid},no={kid + 1}{miss}{stat}")

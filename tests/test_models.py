@@ -480,6 +480,15 @@ class TestGBTExtras:
         d3 = mm3.dump_model()
         assert d3.count("class[") == 6          # 2 trees x 3 classes
         assert d3.count(":leaf=") == 6 * 4      # 2^2 leaves per section
+        # feature_names replaces the f<N> placeholders (fmap role) and
+        # validates its length
+        names = [f"col_{i}" for i in range(X.shape[1])]
+        dn = m.dump_model(feature_names=names)
+        assert "[col_" in dn
+        assert "[f0<" not in dn
+        from dmlc_core_tpu.base.logging import Error
+        with pytest.raises(Error):
+            m.dump_model(feature_names=["just_one"])
 
     def test_feature_importances(self):
         from dmlc_core_tpu.models import HistGBT
