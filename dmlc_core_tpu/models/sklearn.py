@@ -147,6 +147,18 @@ class _EstimatorBase(_SkBase):
         CHECK(self._model is not None, "call fit first")
         return self._model
 
+    def _watch_eval_set(self, fit_kw: Dict[str, Any]) -> Dict[str, Any]:
+        """Unwrap XGBoost's list-of-pairs ``eval_set``: the LAST pair is
+        watched (early-stopping semantics) and its index recorded for
+        :meth:`evals_result`'s key.  Shared by every wrapper fit."""
+        ev = fit_kw.get("eval_set")
+        self._watched_eval_idx = 0
+        if isinstance(ev, list):
+            CHECK(len(ev) > 0, "eval_set: empty list")
+            self._watched_eval_idx = len(ev) - 1
+            fit_kw["eval_set"] = ev[-1]
+        return fit_kw
+
     def evals_result(self) -> Dict[str, Dict[str, list]]:
         """XGBoost-shaped validation curve of the last ``eval_set`` fit
         (one point per dispatch chunk — XGBoost records per round; the
@@ -206,16 +218,12 @@ class GBTClassifier(_SkClf, _EstimatorBase):
         if fit_kw.get("eval_set") is not None:
             # validation labels go through the SAME encoding as y.
             # XGBClassifier takes a LIST of (X, y) pairs and its early
-            # stopping watches the LAST one; a bare (X, y) tuple is
-            # accepted too.  String or non-contiguous labels would
-            # otherwise reach the booster raw.
-            ev = fit_kw["eval_set"]
-            self._watched_eval_idx = 0
-            if isinstance(ev, list):
-                CHECK(len(ev) > 0, "eval_set: empty list")
-                self._watched_eval_idx = len(ev) - 1
-                ev = ev[-1]
-            Xv, yv = ev
+            # stopping watches the LAST one (shared _watch_eval_set); a
+            # bare (X, y) tuple is accepted too.  String or
+            # non-contiguous labels would otherwise reach the booster
+            # raw.
+            fit_kw = self._watch_eval_set(fit_kw)
+            Xv, yv = fit_kw["eval_set"]
             yv = np.asarray(yv)
             CHECK(np.isin(yv, self.classes_).all(),
                   "eval_set labels contain classes not present in y")
@@ -252,6 +260,7 @@ class GBTRegressor(_SkReg, _EstimatorBase):
             sample_weight: Optional[np.ndarray] = None,
             **fit_kw: Any) -> "GBTRegressor":
         self._model = self._make("reg:squarederror")
+        fit_kw = self._watch_eval_set(fit_kw)
         self._model.fit(X, np.asarray(y, np.float32),
                         weight=sample_weight, **fit_kw)
         return self

@@ -221,3 +221,18 @@ class TestEvalsResult:
         est.fit(X, yb)
         with pytest.raises(Error):
             est.evals_result()
+
+    def test_regressor_eval_set_list_form(self):
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(1200, 5)).astype(np.float32)
+        y = (2 * X[:, 0] - X[:, 1] + 0.1 * rng.normal(size=1200)).astype(
+            np.float32)
+        reg = GBTRegressor(n_estimators=60, max_depth=3, n_bins=32,
+                           eval_metric="rmse")
+        reg.fit(X[:900], y[:900], eval_set=[(X[:900], y[:900]),
+                                            (X[900:], y[900:])],
+                early_stopping_rounds=10)
+        res = reg.evals_result()
+        assert list(res) == ["validation_1"]
+        curve = res["validation_1"]["rmse"]
+        assert len(curve) >= 2 and curve[-1] <= curve[0]
