@@ -155,8 +155,12 @@ class _EstimatorBase(_SkBase):
         self._watched_eval_idx = 0
         if isinstance(ev, list):
             CHECK(len(ev) > 0, "eval_set: empty list")
-            self._watched_eval_idx = len(ev) - 1
-            fit_kw["eval_set"] = ev[-1]
+            # only unwrap the list-of-PAIRS form: a bare [Xv, yv] list
+            # (tuple spelled as a list) must pass through as the single
+            # pair it is, not be misread as two pairs
+            if isinstance(ev[0], (tuple, list)):
+                self._watched_eval_idx = len(ev) - 1
+                fit_kw["eval_set"] = ev[-1]
         return fit_kw
 
     def evals_result(self) -> Dict[str, Dict[str, list]]:

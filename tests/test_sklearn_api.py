@@ -236,3 +236,12 @@ class TestEvalsResult:
         assert list(res) == ["validation_1"]
         curve = res["validation_1"]["rmse"]
         assert len(curve) >= 2 and curve[-1] <= curve[0]
+
+    def test_bare_pair_spelled_as_list(self):
+        """eval_set=[Xv, yv] (a single pair spelled as a list) must be
+        treated as one pair, not misread as a two-pair list."""
+        X, yb = _cls_data(n=800)
+        Xv, ybv = _cls_data(n=200, seed=4)
+        est = GBTClassifier(n_estimators=5, max_depth=2, n_bins=16)
+        est.fit(X, yb, eval_set=[Xv, ybv])
+        assert list(est.evals_result()) == ["validation_0"]
