@@ -1131,6 +1131,28 @@ class TestMissingValues:
             m.fit(Xm, y, cuts=jnp.asarray(bad))
 
 
+
+    def test_missing_with_sampling(self):
+        """Missing mode composes with subsample/colsample (the sampled
+        round program threads dir through the scan carry)."""
+        from dmlc_core_tpu.models import HistGBT
+
+        _, Xm, y, mask = self._mnar_problem(n=1500, seed=21)
+        m = HistGBT(n_trees=10, max_depth=3, n_bins=32,
+                    subsample=0.8, colsample_bytree=0.8, seed=3)
+        m.fit(Xm, y)
+        assert m._missing and "dir" in m.trees[0]
+        pred = m.predict(Xm) > 0.5
+        assert (pred[mask] == y[mask]).mean() > 0.85
+        # deterministic across cached instances (same seed)
+        m2 = HistGBT(n_trees=10, max_depth=3, n_bins=32,
+                     subsample=0.8, colsample_bytree=0.8, seed=3)
+        m2.fit(Xm, y)
+        for a, b in zip(m.trees, m2.trees):
+            np.testing.assert_array_equal(a["feat"], b["feat"])
+            np.testing.assert_array_equal(a["dir"], b["dir"])
+
+
 class TestScalePosWeight:
     """scale_pos_weight (XGBoost's imbalanced-data knob): positives'
     grad/hess scale by the factor — definitionally an instance weight,
