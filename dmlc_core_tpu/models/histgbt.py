@@ -323,10 +323,24 @@ def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray,
     return out
 
 
+def _soft_threshold(G, alpha: float):
+    """XGBoost's ThresholdL1: shrink the gradient sum toward 0 by the
+    L1 penalty before forming weights/gains."""
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
+
+
+def _maybe_l1(G, alpha: float):
+    """The shared alpha gate for LEAF-weight sites: thresholded gradient
+    sum when L1 is on, the raw sum (identical trace) when off.  The
+    split chooser's gain keeps its own gate because its alpha=0 branch
+    must preserve the exact ``G**2`` primitive of the pre-alpha trace."""
+    return _soft_threshold(G, alpha) if alpha > 0.0 else G
+
+
 def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
                      with_child_sums: bool = False,
                      mono: Optional[np.ndarray] = None,
-                     missing: bool = False):
+                     missing: bool = False, alpha: float = 0.0):
     """Greedy per-node split chooser over a gradient histogram.
 
     hist [2,N,F,B] → (feat [N], thr [N], split_gain [N]); degenerate
@@ -378,6 +392,16 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         hl = ch[..., :-1]
         gt = cg[..., -1:]                            # [N,F,1]
         ht = ch[..., -1:]
+        if alpha > 0.0:
+            # XGBoost alpha: gain term T(G)²/(H+λ) with the
+            # soft-thresholded gradient sum (gated so alpha=0 keeps the
+            # exact pre-alpha trace)
+            def _score(G, H):
+                t = _soft_threshold(G, alpha)
+                return t * t / (H + lam)
+        else:
+            def _score(G, H):
+                return G**2 / (H + lam)
         dir_l = None
         if missing:
             miss_g = g[..., B - 1]                   # [N,F] NaN-bin mass
@@ -386,8 +410,8 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
             def side_gain(gl_, hl_):
                 gr_ = gt - gl_
                 hr_ = ht - hl_
-                gn = (gl_**2 / (hl_ + lam) + gr_**2 / (hr_ + lam)
-                      - gt**2 / (ht + lam))
+                gn = (_score(gl_, hl_) + _score(gr_, hr_)
+                      - _score(gt, ht))
                 ok_ = (hl_ >= mcw) & (hr_ >= mcw)
                 return jnp.where(ok_, gn, -jnp.inf)
 
@@ -399,8 +423,7 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
         else:
             gr = gt - gl
             hr = ht - hl
-            gain = (gl**2 / (hl + lam) + gr**2 / (hr + lam)
-                    - gt**2 / (ht + lam))
+            gain = (_score(gl, hl) + _score(gr, hr) - _score(gt, ht))
         if mono is not None:
             # bounds bind the REALIZABLE child weights, so gain must be
             # evaluated at the clipped weights (XGBoost's constrained
@@ -546,8 +569,8 @@ def _ext_sib_stack(hist, prev_hist, *, level, B):
 
 
 @lru_cache(maxsize=None)
-def _ext_split_fn(B, lam, gamma, mcw):
-    return jax.jit(_make_best_split(B, lam, gamma, mcw))
+def _ext_split_fn(B, lam, gamma, mcw, alpha=0.0):
+    return jax.jit(_make_best_split(B, lam, gamma, mcw, alpha=alpha))
 
 
 @partial(jax.jit, static_argnames=("col", "n_leaf"))
@@ -558,9 +581,10 @@ def _ext_upd_preds(preds, node, leaf, *, col, n_leaf):
     return preds.at[:, col].add(gain)
 
 
-@partial(jax.jit, static_argnames=("lam", "eta"))
-def _ext_leaf_calc(gsum, hsum, *, lam, eta):
-    return (-gsum / (hsum + lam) * eta).astype(jnp.float32)
+@partial(jax.jit, static_argnames=("lam", "eta", "alpha"))
+def _ext_leaf_calc(gsum, hsum, *, lam, eta, alpha=0.0):
+    return (-_maybe_l1(gsum, alpha) / (hsum + lam)
+            * eta).astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("half",))
@@ -641,6 +665,9 @@ class HistGBTParam(Parameter):
                    description="feature quantization bins (max_bin)")
     learning_rate = field(float, default=0.3, lower_bound=0.0, description="eta")
     reg_lambda = field(float, default=1.0, lower_bound=0.0, description="L2 on leaf weights")
+    reg_alpha = field(float, default=0.0, lower_bound=0.0,
+                      description="L1 on leaf weights (XGBoost alpha: "
+                                  "soft-thresholded gradient sums)")
     gamma = field(float, default=0.0, lower_bound=0.0, description="min split gain")
     min_child_weight = field(float, default=1.0, lower_bound=0.0)
     objective = field(str, default="binary:logistic",
@@ -1660,10 +1687,10 @@ class HistGBT:
         final_adv_leaf = partial(_ext_final_adv_leaf, n_leaf=n_leaf)
         sib_stack = partial(_ext_sib_stack, B=B)
         split_fn = _ext_split_fn(B, p.reg_lambda, p.gamma,
-                                 p.min_child_weight)
+                                 p.min_child_weight, p.reg_alpha)
         upd_preds = partial(_ext_upd_preds, n_leaf=n_leaf)
         leaf_calc = partial(_ext_leaf_calc, lam=p.reg_lambda,
-                            eta=p.learning_rate)
+                            eta=p.learning_rate, alpha=p.reg_alpha)
         pack_tree = partial(_ext_pack_tree, half=half)
         eval_loss = partial(_ext_eval_loss, obj=obj)
 
@@ -1836,7 +1863,8 @@ class HistGBT:
         mono = (tuple(int(v) for v in p.monotone_constraints)
                 if p.monotone_constraints else None)
         return (self.mesh, n_features, n_rounds, p.max_depth, p.n_bins,
-                p.learning_rate, p.reg_lambda, p.gamma, p.min_child_weight,
+                p.learning_rate, p.reg_lambda, p.reg_alpha, p.gamma,
+                p.min_child_weight,
                 p.hist_method, obj_key, mono, p.subsample,
                 p.colsample_bytree, p.num_class, self._missing,
                 os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"))
@@ -1854,6 +1882,7 @@ class HistGBT:
         B = p.n_bins
         eta = p.learning_rate
         lam = p.reg_lambda
+        alpha = p.reg_alpha
         gamma = p.gamma
         mcw = p.min_child_weight
         method = p.hist_method
@@ -1874,11 +1903,17 @@ class HistGBT:
                   "supported (learned missing direction would need "
                   "direction-aware bound propagation) — impute missing "
                   "values or drop the constraints")
+        if alpha > 0.0:
+            CHECK(mono_arr is None,
+                  "monotone_constraints with reg_alpha is not supported "
+                  "(the constrained gain evaluation would need the L1 "
+                  "term at the clipped weights) — drop one of the two")
         best_split = _make_best_split(B, lam, gamma, mcw, mono=mono_arr,
-                                      missing=missing)
+                                      missing=missing, alpha=alpha)
         best_split_leaf = _make_best_split(B, lam, gamma, mcw,
                                            with_child_sums=True,
-                                           mono=mono_arr, missing=missing)
+                                           mono=mono_arr, missing=missing,
+                                           alpha=alpha)
         # snapshot EVERY param the traced closure reads: the program is
         # cached process-wide under the key above, and a later retrace
         # (new input shape) must not see live mutations of some other
@@ -2024,7 +2059,7 @@ class HistGBT:
                 go_right = jnp.where(row_bin == B - 1, dir_sel == 0,
                                      go_right)
             node = 2 * node + go_right.astype(jnp.int32)
-            leaf_w = -gsum / (hsum + lam)
+            leaf_w = -_maybe_l1(gsum, alpha) / (hsum + lam)
             if mono_arr is not None:
                 leaf_w = jnp.clip(leaf_w, bounds[:, 0], bounds[:, 1])
             leaf = leaf_w * eta

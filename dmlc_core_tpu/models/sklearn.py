@@ -133,7 +133,8 @@ class _EstimatorBase(_SkBase):
         kw: Dict[str, Any] = dict(
             n_trees=self.n_estimators, max_depth=self.max_depth,
             learning_rate=self.learning_rate, n_bins=self.n_bins,
-            reg_lambda=self.reg_lambda, subsample=self.subsample,
+            reg_lambda=self.reg_lambda, reg_alpha=self.reg_alpha,
+            subsample=self.subsample,
             colsample_bytree=self.colsample_bytree,
             objective=objective, seed=self.seed)
         if num_class > 1:

@@ -1153,6 +1153,84 @@ class TestMissingValues:
             np.testing.assert_array_equal(a["dir"], b["dir"])
 
 
+class TestRegAlpha:
+    """reg_alpha (XGBoost L1 on leaf weights): gradient sums are
+    soft-thresholded before weights and gains — ThresholdL1(G, a) =
+    sign(G) * max(|G| - a, 0)."""
+
+    def test_leaf_weights_shrink_toward_zero(self):
+        X, y = _synthetic(n=2000, f=5, seed=17)
+        base = HistGBT(n_trees=5, max_depth=3, n_bins=32)
+        base.fit(X, y)
+        l1 = HistGBT(n_trees=5, max_depth=3, n_bins=32, reg_alpha=2.0)
+        l1.fit(X, y)
+        m0 = np.mean([np.abs(t["leaf"]).mean() for t in base.trees])
+        m1 = np.mean([np.abs(t["leaf"]).mean() for t in l1.trees])
+        assert m1 < m0, (m1, m0)
+        # huge alpha kills every leaf: |G| can never exceed it
+        dead = HistGBT(n_trees=2, max_depth=3, n_bins=32,
+                       reg_alpha=1e9)
+        dead.fit(X, y)
+        for t in dead.trees:
+            np.testing.assert_allclose(t["leaf"], 0.0, atol=1e-7)
+
+    def test_first_tree_root_leaf_matches_formula(self):
+        """Depth-1 single tree: the two leaf weights must equal
+        -eta * T(G_child, a) / (H_child + lam) computed by hand from
+        the logistic gradients at the base margin."""
+        rng = np.random.default_rng(23)
+        X = rng.normal(size=(4096, 3)).astype(np.float32)
+        y = (X[:, 0] > 0.2).astype(np.float32)
+        a, lam, eta = 5.0, 1.0, 1.0
+        m = HistGBT(n_trees=1, max_depth=1, n_bins=32, learning_rate=eta,
+                    reg_lambda=lam, reg_alpha=a)
+        m.fit(X, y)
+        # logistic grads at margin 0: g = 0.5 - y, h = 0.25
+        g = 0.5 - y
+        h = np.full_like(y, 0.25)
+        feat = int(m.trees[0]["feat"][0][0])
+        thr = int(m.trees[0]["thr"][0][0])
+        cuts = np.asarray(m.cuts)
+        bins = np.searchsorted(cuts[feat], X[:, feat], side="right")
+        left = bins <= thr
+        def w(mask):
+            G, H = g[mask].sum(), h[mask].sum()
+            T = np.sign(G) * max(abs(G) - a, 0.0)
+            return -eta * T / (H + lam)
+        np.testing.assert_allclose(
+            m.trees[0]["leaf"], [w(left), w(~left)], rtol=2e-3, atol=1e-4)
+
+    def test_external_chunked_applies_alpha(self, tmp_path, monkeypatch):
+        from dmlc_core_tpu.data.iter import RowBlockIter
+
+        X, y = _synthetic(n=1500, f=4, seed=19)
+        path = tmp_path / "a.libsvm"
+        with open(path, "w") as f:
+            for i in range(len(y)):
+                feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(4))
+                f.write(f"{int(y[i])} {feats}\n")
+        monkeypatch.setenv("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", "40000")
+        e0 = HistGBT(n_trees=3, max_depth=3, n_bins=16)
+        e0.fit_external(
+            RowBlockIter.create(str(path), 0, 1, "libsvm"), num_col=4)
+        e1 = HistGBT(n_trees=3, max_depth=3, n_bins=16, reg_alpha=3.0)
+        e1.fit_external(
+            RowBlockIter.create(str(path), 0, 1, "libsvm"), num_col=4)
+        m0 = np.mean([np.abs(t["leaf"]).mean() for t in e0.trees])
+        m1 = np.mean([np.abs(t["leaf"]).mean() for t in e1.trees])
+        assert m1 < m0, (m1, m0)
+
+    def test_mono_plus_alpha_rejected(self):
+        import pytest as pt
+        from dmlc_core_tpu.base.logging import Error
+
+        X, y = _synthetic(n=512, f=4, seed=3)
+        m = HistGBT(n_trees=2, max_depth=2, n_bins=16, reg_alpha=0.5,
+                    monotone_constraints=[1, 0, 0, 0])
+        with pt.raises(Error):
+            m.fit(X, y)
+
+
 class TestScalePosWeight:
     """scale_pos_weight (XGBoost's imbalanced-data knob): positives'
     grad/hess scale by the factor — definitionally an instance weight,
