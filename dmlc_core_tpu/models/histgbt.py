@@ -382,6 +382,9 @@ def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
     Degenerate nodes keep thr = B-1 / dir = 1: every row, missing
     included, goes left.
     """
+    CHECK(mono is None or not missing,
+          "monotone constraints are not supported with missing=True "
+          "(the constrained-gain branch has no missing-direction form)")
 
     def best_split(hist, feat_mask=None, bounds=None):
         g = hist[0]
@@ -568,7 +571,7 @@ def _ext_sib_stack(hist, prev_hist, *, level, B):
         2, n_nodes, hist.shape[2], B)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=64)
 def _ext_split_fn(B, lam, gamma, mcw, alpha=0.0):
     return jax.jit(_make_best_split(B, lam, gamma, mcw, alpha=alpha))
 

@@ -174,7 +174,16 @@ class _EstimatorBase(_SkBase):
         position — ``validation_{n-1}`` for an n-pair list — so code
         expecting XGBoost's per-pair dict fails with a loud KeyError on
         the untracked pairs instead of silently misreading e.g. the
-        validation curve as the training curve."""
+        validation curve as the training curve.
+
+        Granularity differs from XGBoost: one point per *dispatch chunk*
+        (the compiled multi-round step), not per boosting round, so
+        ``len(curve) != n_estimators`` in general.  Every key of the
+        returned per-dataset dict is a metric name (the XGBoost contract
+        generic consumers iterate over); each point's boosting-round
+        index lives on ``self.model.eval_history`` as ``(round, score)``
+        pairs — use ``[r for r, _ in est.model.eval_history]`` as the
+        x-axis (see ``doc/migration.md``)."""
         m = self.model
         name = getattr(m, "eval_metric_name", None)
         CHECK(name is not None,

@@ -66,7 +66,12 @@ def local_summary(x: jax.Array, weight: Optional[jax.Array],
     a zero-weight duplicate knot that cannot move any quantile (the
     fixed-shape alternative to per-feature nan-filtering, which would
     break the [F, n_summary] contract when NaN counts differ by
-    feature).  Callers must reject all-NaN features (the max is -inf).
+    feature).  A feature with NO finite value on this worker emits an
+    explicit all-NaN sentinel row (total weight 0), which
+    :func:`merge_summaries` excludes — a shard-local all-NaN column is
+    legal in distributed fits as long as the feature is finite on SOME
+    worker (callers enforce the global check, histgbt's finite_any
+    allreduce).
     """
     n, F = x.shape
     qs = jnp.linspace(0.0, 1.0, n_summary)
@@ -89,22 +94,44 @@ def local_summary(x: jax.Array, weight: Optional[jax.Array],
     probs = (cw - 0.5 * ws) / total                                   # midpoint rule
     def per_f(xf, pf):
         return jnp.interp(qs, pf, xf)
-    return jax.vmap(per_f, in_axes=(1, 1))(xs, probs)                 # [F, n_summary]
+    out = jax.vmap(per_f, in_axes=(1, 1))(xs, probs)                  # [F, n_summary]
+    if missing:
+        # zero total weight = all-NaN column on this shard: the -inf/0-div
+        # garbage above is made a deterministic NaN sentinel row here.
+        out = jnp.where((total[0] <= 0.0)[:, None], jnp.nan, out)
+    return out
 
 
 @partial(jax.jit, static_argnums=(1,))
 def merge_summaries(gathered: jax.Array, n_bins: int) -> jax.Array:
     """Merge ``[W, F, n_summary]`` worker summaries into ``[F, n_bins-1]``
-    cut points (interior boundaries; bin b = count of cuts ≤ x)."""
+    cut points (interior boundaries; bin b = count of cuts ≤ x).
+
+    NaN summary points (a worker whose shard had no finite value for the
+    feature — :func:`local_summary`'s sentinel rows) are excluded via
+    ``nanquantile``, so a feature all-NaN on one shard but finite globally
+    still gets finite cuts from the workers that saw it.  A feature with no
+    finite point on ANY worker (callers reject this up front) degrades to a
+    deterministic finite ramp rather than NaN cuts — NaN cuts would make
+    ``searchsorted`` silently bin every finite value to 0.
+    """
     W, F, S = gathered.shape
     merged = jnp.transpose(gathered, (1, 0, 2)).reshape(F, W * S)
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    cuts = jnp.quantile(merged, qs, axis=1).T                         # [F, n_bins-1]
-    # strictly increasing guard: collapse duplicate cuts upward by epsilon
+    cuts = jnp.nanquantile(merged, qs, axis=1).T                      # [F, n_bins-1]
+    cuts = jnp.where(jnp.isnan(cuts),
+                     jnp.arange(n_bins - 1, dtype=cuts.dtype)[None, :], cuts)
+    # Strictly-increasing guard: s_i = max(c_i, s_{i-1} + eps_{i-1}) —
+    # an atom-dominated feature (e.g. a sparse column densified to 0.0)
+    # puts a RUN of quantile targets on one value, and a single-pass bump
+    # against the unadjusted neighbor leaves runs ≥ 3 non-strict.  The
+    # recurrence is a prefix max in disguise: with E = exclusive-prefix
+    # sum of eps, s_i = E_i + cummax(c − E)_i, so duplicates fan upward
+    # by one eps per position (rows still route identically — the bumped
+    # copies sit between the atom and the next real value).
     eps = jnp.maximum(jnp.abs(cuts) * 1e-6, 1e-6)
-    cuts = jnp.maximum(cuts, jnp.concatenate(
-        [cuts[:, :1] - 1.0, cuts[:, :-1] + eps[:, :-1]], axis=1))
-    return cuts
+    E = jnp.cumsum(eps, axis=1) - eps
+    return E + jax.lax.cummax(cuts - E, axis=1)
 
 
 def compute_cuts(

@@ -183,6 +183,58 @@ class TestQuantile:
         bins = np.asarray(apply_bins(jnp.asarray(x), cuts))
         assert (bins >= 0).all() and (bins < 8).all()
 
+    def test_atom_dominated_cuts_strictly_increase(self):
+        # A sparse column densified to 0.0 puts a RUN of quantile targets
+        # on one atom; the guard must fan the whole run apart (the old
+        # single-pass bump left runs >= 3 non-strict).
+        x = np.zeros((1000, 2), np.float32)
+        x[:30, 0] = np.linspace(1, 2, 30)
+        x[:, 1] = np.linspace(-1, 1, 1000)
+        cuts = np.asarray(compute_cuts(x, n_bins=32))
+        assert (np.diff(cuts, axis=1) > 0).all()
+        # the fanned copies stay below the next real value: rows at the
+        # atom and rows at 1.0 must still separate
+        bins = np.asarray(apply_bins(jnp.asarray(x), jnp.asarray(cuts)))
+        assert bins[:30, 0].min() > bins[31:, 0].max()
+
+    def test_missing_all_nan_on_one_shard(self, rng):
+        # A feature entirely NaN on ONE worker but finite globally must
+        # not poison the merged cuts (round-4 advisor finding: the NaN
+        # sentinel row used to propagate through jnp.quantile and
+        # collapse the feature to bin 0 on every worker).
+        x0 = rng.normal(size=(500, 3)).astype(np.float32)
+        x0[:, 1] = np.nan                      # worker 0: f1 all missing
+        x1 = rng.normal(size=(500, 3)).astype(np.float32)
+        s0 = local_summary(jnp.asarray(x0), None, 128, True)
+        s1 = local_summary(jnp.asarray(x1), None, 128, True)
+        assert np.isnan(np.asarray(s0)[1]).all()      # sentinel row
+        assert np.isfinite(np.asarray(s0)[[0, 2]]).all()
+        cuts = np.asarray(merge_summaries(jnp.stack([s0, s1]), 16))
+        assert np.isfinite(cuts).all()
+        assert (np.diff(cuts, axis=1) > 0).all()
+        # f1's cuts must equal what worker 1 alone would produce: the
+        # NaN row contributes zero points to the merge
+        solo = np.asarray(merge_summaries(s1[None], 16))
+        np.testing.assert_allclose(cuts[1], solo[1], rtol=1e-6)
+        # and the same end to end through compute_cuts + a fake gather
+        def gather(s):
+            return np.stack([np.asarray(local_summary(
+                jnp.asarray(x0), None, s.shape[1], True)), s])
+        cuts2 = np.asarray(compute_cuts(
+            x1, n_bins=16, n_summary=128, allgather_fn=gather, missing=True))
+        assert np.isfinite(cuts2).all()
+
+    def test_missing_all_nan_everywhere_degrades_finite(self, rng):
+        # Globally all-NaN features are rejected by callers up front;
+        # the merge itself must still emit finite increasing cuts (not
+        # NaN, which would silently bin every value to 0 downstream).
+        x = np.full((50, 2), np.nan, np.float32)
+        x[:, 0] = rng.normal(size=50)
+        s = local_summary(jnp.asarray(x), None, 64, True)
+        cuts = np.asarray(merge_summaries(s[None], 8))
+        assert np.isfinite(cuts).all()
+        assert (np.diff(cuts, axis=1) > 0).all()
+
 
 def _synthetic(n=2000, f=10, seed=0):
     rng = np.random.default_rng(seed)

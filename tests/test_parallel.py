@@ -550,7 +550,13 @@ class TestMultiProcessLocal:
         missing-aware cut allgather (fixed-shape zero-weight NaN knots),
         the missing-bin histogram psum, and per-node direction choice
         must all agree across the cluster, and both ranks must learn
-        the MNAR signal (only recoverable via the learned direction)."""
+        the MNAR signal (only recoverable via the learned direction).
+
+        Feature 5 is additionally ALL-NaN on rank 0's shard (finite on
+        rank 1's): its local summary is the NaN sentinel row and the
+        merged cuts must come out finite from rank 1's contribution
+        alone (round-4 advisor finding — this used to NaN-poison the
+        feature's cuts on every worker)."""
         script = tmp_path / "gbt_missing_worker.py"
         script.write_text(textwrap.dedent(
             """
@@ -573,6 +579,7 @@ class TestMultiProcessLocal:
             mask = np.zeros(512, bool)
             mask[:256] = X[:256, 0] > 0
             Xm[mask, 0] = np.nan
+            Xm[:256, 5] = np.nan   # all-NaN on rank 0's shard only
 
             kw = dict(n_trees=6, max_depth=3, n_bins=32,
                       learning_rate=0.5)
@@ -580,6 +587,8 @@ class TestMultiProcessLocal:
                                      ("data",)), **kw)
             dist.fit(Xm, y)
             assert dist._missing, "mode must be ON on every rank"
+            assert np.isfinite(np.asarray(dist.cuts)).all(), \\
+                "all-NaN-on-one-shard feature poisoned the merged cuts"
             local = HistGBT(
                 mesh=Mesh(np.array(jax.local_devices()), ("data",)),
                 **kw)
