@@ -1,0 +1,371 @@
+"""GBT training objectives and eval metrics (the booster's loss surface).
+
+Functional parity: XGBoost's objective registry (reference
+``src/objective/`` family — binary:logistic, multi:softmax,
+reg:squarederror, rank:pairwise; SURVEY.md §1 consumer surface) and its
+``eval_metric`` table.  Split out of ``histgbt.py`` so the objective
+registry is importable without the tree engine (GBLinear shares it).
+
+Every objective provides ``grad_hess`` (the boosting step's inputs),
+``transform`` (margin → prediction), ``row_loss``/``metric`` (training
+eval), and ``finalize_mean_loss`` (the external-memory path's
+mean-of-sums finalizer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.base.registry import Registry
+
+__all__ = ["OBJECTIVES", "EVAL_METRICS", "fold_scale_pos_weight"]
+
+OBJECTIVES: Registry = Registry.get("gbt_objective")
+
+
+class _ObjectiveBase:
+    """Shared objective plumbing: the metric is the mean of per-row
+    losses and the external-memory path's finalizer is the identity —
+    objectives override only where that isn't true (rmse)."""
+
+    @classmethod
+    def metric(cls, pred, y):
+        return jnp.mean(cls.row_loss(pred, y))
+
+    @staticmethod
+    def finalize_mean_loss(m: float) -> float:
+        return m
+
+
+@OBJECTIVES.register("binary:logistic")
+class _Logistic(_ObjectiveBase):
+    """grad/hess of log loss on raw margins; transform = sigmoid."""
+
+    @staticmethod
+    def grad_hess(pred, y):
+        p = jax.nn.sigmoid(pred)
+        return p - y, p * (1.0 - p)
+
+    @staticmethod
+    def transform(pred):
+        return jax.nn.sigmoid(pred)
+
+    @staticmethod
+    def row_loss(pred, y):  # per-row logloss
+        p = jax.nn.sigmoid(pred)
+        eps = 1e-7
+        return -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+
+
+@OBJECTIVES.register("multi:softmax")
+class _Softmax(_ObjectiveBase):
+    """K-class softmax objective (XGBoost ``multi:softmax``) — margins are
+    [n, K]; grad/hess per class from the full softmax row.  ``predict``
+    returns argmax classes (``multi:softprob`` = same training, transform
+    returns the probability matrix)."""
+
+    @staticmethod
+    def grad_hess(pred, y):                  # pred [n,K], y [n] labels
+        K = pred.shape[1]
+        prob = jax.nn.softmax(pred, axis=1)
+        yoh = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=pred.dtype)
+        return prob - yoh, jnp.maximum(2.0 * prob * (1.0 - prob), 1e-6)
+
+    @staticmethod
+    def transform(pred):                     # class index
+        return jnp.argmax(pred, axis=1).astype(jnp.float32)
+
+    @staticmethod
+    def prob(pred):
+        return jax.nn.softmax(pred, axis=1)
+
+    @staticmethod
+    def row_loss(pred, y):                   # mlogloss
+        logp = jax.nn.log_softmax(pred, axis=1)
+        return -jnp.take_along_axis(
+            logp, y.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@OBJECTIVES.register("reg:squarederror")
+class _SquaredError(_ObjectiveBase):
+    @staticmethod
+    def grad_hess(pred, y):
+        return pred - y, jnp.ones_like(pred)
+
+    @staticmethod
+    def transform(pred):
+        return pred
+
+    @staticmethod
+    def row_loss(pred, y):  # per-row squared error
+        return (pred - y) ** 2
+
+    @classmethod
+    def metric(cls, pred, y):  # rmse = sqrt of the mean row loss
+        return jnp.sqrt(jnp.mean(cls.row_loss(pred, y)))
+
+    @staticmethod
+    def finalize_mean_loss(m: float) -> float:
+        return float(np.sqrt(m))
+
+
+@OBJECTIVES.register("rank:pairwise")
+class _PairwiseRank(_ObjectiveBase):
+    """RankNet-style pairwise ranking over ``qid`` groups (XGBoost
+    ``rank:pairwise`` — the consumer of the data plane's qid column,
+    reference ``data.h :: Row::qid``, SURVEY.md §2a).
+
+    Contract with :meth:`HistGBT.fit`: rows arrive GROUPED AND PADDED —
+    every query occupies exactly ``group_size`` consecutive rows (pad
+    docs carry ``y = -1`` and weight 0), and shard boundaries fall on
+    group boundaries, so each device's shard is whole groups and the
+    pairwise gradients are shard-local (no cross-device pairs; the
+    histogram psum is the only collective, unchanged).
+
+    Per better-pair (i, j) with rel_i > rel_j inside one group:
+    ``λ = σ(s_j − s_i)``; ``∂L/∂s_i −= λ``, ``∂L/∂s_j += λ``, and both
+    docs accumulate hessian ``λ(1−λ)``.  Groups are processed in
+    ``lax.map`` blocks of ``block_queries`` so the [QB, G, G] pairwise
+    tensors stay a bounded transient instead of O(n·G) at once.
+    """
+
+    is_ranking = True
+
+    def __init__(self, group_size: int, block_queries: int = 256):
+        self.G = int(group_size)
+        self.QB = int(block_queries)
+
+    def _map_blocks(self, pred, y, block_fn):
+        """Shared scaffolding: reshape flat rows into [Q, G] queries, pad
+        the query count to the block multiple (pad queries carry rel −1 →
+        no pairs), and ``lax.map`` over [QB, G] blocks.  ``block_fn``
+        receives the pairwise margin differences ``S[i, j] = s_i − s_j``,
+        the better-pair mask, and the raw per-block scores/relevances
+        ``sb, rb`` [QB, G] (the lambda-weighting subclasses need rank
+        positions) and returns any pytree of per-block results (the
+        gradients and the loss derive from exactly these tensors, so
+        padding/sentinel rules live in ONE place).
+        """
+        G = self.G
+        Q = pred.shape[0] // G
+        QB = min(self.QB, Q)
+        qpad = (-Q) % QB
+        s = jnp.pad(pred.reshape(Q, G), ((0, qpad), (0, 0)))
+        r = jnp.pad(y.reshape(Q, G), ((0, qpad), (0, 0)),
+                    constant_values=-1.0)
+
+        def block(args):
+            sb, rb = args                                   # [QB, G]
+            vb = rb >= 0
+            S = sb[:, :, None] - sb[:, None, :]             # s_i − s_j
+            better = ((rb[:, :, None] > rb[:, None, :])
+                      & vb[:, :, None] & vb[:, None, :])
+            return block_fn(S, better, sb, rb)
+
+        nb = (Q + qpad) // QB
+        out = jax.lax.map(block, (s.reshape(nb, QB, G),
+                                  r.reshape(nb, QB, G)))
+        return out, Q
+
+    def _pair_weight(self, sb, rb, better):
+        """Per-pair lambda weight ``[QB, G, G]`` or None (unweighted
+        RankNet).  The LambdaMART subclasses return |Δmetric| of
+        swapping the pair in the current ranking."""
+        return None
+
+    def grad_hess(self, pred, y):
+        def block_fn(S, better, sb, rb):
+            lam = jnp.where(better, jax.nn.sigmoid(-S), 0.0)
+            rho = lam * (1.0 - lam)
+            w = self._pair_weight(sb, rb, better)
+            if w is not None:
+                lam = lam * w
+                rho = rho * w
+            g = -lam.sum(axis=2) + lam.sum(axis=1)          # winner/loser
+            h = rho.sum(axis=2) + rho.sum(axis=1)
+            return g, h
+
+        (g, h), Q = self._map_blocks(pred, y, block_fn)
+        G = self.G
+        g = g.reshape(-1, G)[:Q].reshape(Q * G)
+        h = h.reshape(-1, G)[:Q].reshape(Q * G)
+        # docs with no pairs get h=0 → leaf math guards with +lambda, but
+        # keep hessians nonnegative-and-tiny like XGBoost's floor
+        return g, jnp.maximum(h, 1e-16)
+
+    @staticmethod
+    def transform(pred):
+        return pred
+
+    def row_loss(self, pred, y):  # pairwise logloss, averaged per pair
+        log_fatal("rank objectives have no per-row loss; use metric()")
+
+    def metric(self, pred, y):
+        """Mean pairwise logistic loss over all better-pairs (same
+        blocked scaffolding as grad_hess — one padding/sentinel rule).
+        Shared by the LambdaMART subclasses: the weighted objectives
+        still bound pairwise misordering, and a group-aware ndcg/map
+        eval lives in ``models.ranking`` (host-side, on predictions)."""
+        def block_fn(S, better, sb, rb):
+            return (jnp.where(better, jnp.logaddexp(0.0, -S), 0.0).sum(),
+                    better.sum())
+
+        (losses, counts), _ = self._map_blocks(pred, y, block_fn)
+        return losses.sum() / jnp.maximum(counts.sum(), 1)
+
+
+@OBJECTIVES.register("rank:ndcg")
+class _NDCGRank(_PairwiseRank):
+    """LambdaMART over NDCG (XGBoost ``rank:ndcg``): each better-pair's
+    RankNet lambda is weighted by |ΔNDCG| — the change in the query's
+    NDCG if the two docs swapped places in the CURRENT ranking — so
+    gradient mass concentrates on misorderings near the top of the list
+    (Burges' LambdaMART; the delta uses the standard exp2 gain and
+    log2 position discount over the full group).
+
+    Pads (rel −1) rank last (score key +inf) and carry zero gain, so
+    they contribute no weight; a query with IDCG 0 (all rel 0) has no
+    better-pairs to weight.
+    """
+
+    def _pair_weight(self, sb, rb, better):
+        vb = rb >= 0
+        G = sb.shape[-1]
+        f32 = sb.dtype
+        # rank of each doc under the current scores (0 = best), pads last
+        keyed = jnp.where(vb, -sb, jnp.inf)
+        ranks = jnp.argsort(jnp.argsort(keyed, axis=-1), axis=-1)
+        disc = 1.0 / jnp.log2(2.0 + ranks.astype(f32))      # [QB, G]
+        gain = jnp.where(vb, jnp.exp2(rb) - 1.0, 0.0)
+        rel_best = jnp.sort(rb, axis=-1)[:, ::-1]           # ideal order
+        igain = jnp.where(rel_best >= 0, jnp.exp2(rel_best) - 1.0, 0.0)
+        pos_disc = 1.0 / jnp.log2(2.0 + jnp.arange(G, dtype=f32))
+        idcg = (igain * pos_disc[None, :]).sum(axis=-1)     # [QB]
+        inv_idcg = jnp.where(idcg > 0.0, 1.0 / idcg, 0.0)
+        # swapping i and j moves gain_i to disc_j and vice versa:
+        # |ΔDCG| = |g_i − g_j| · |d_i − d_j|
+        return (jnp.abs(gain[:, :, None] - gain[:, None, :])
+                * jnp.abs(disc[:, :, None] - disc[:, None, :])
+                * inv_idcg[:, None, None])
+
+
+@OBJECTIVES.register("rank:map")
+class _MAPRank(_PairwiseRank):
+    """LambdaMART over MAP (XGBoost ``rank:map``, binary relevance:
+    rel > 0 counts as relevant): lambdas weighted by |ΔAP| of swapping
+    the pair in the current ranking.
+
+    Closed form (positions a < b in score order, prefix counts
+    ``c_p = #relevant ≤ p``, ``T_p = Σ_{q≤p} rel_q/(q+1)``, swap shift
+    ``s = rel_b − rel_a``; only positions in [a, b] change):
+
+        R·ΔAP = (rel_b·(c_a + s) − rel_a·c_a)/(a+1)
+              + (rel_a − rel_b)·c_b/(b+1)
+              + s·(T_{b−1} − T_a)
+
+    verified against a brute-force swap-and-rescore in
+    ``tests/test_ranking.py``.
+    """
+
+    def _pair_weight(self, sb, rb, better):
+        vb = rb >= 0
+        G = sb.shape[-1]
+        f32 = sb.dtype
+        rel = jnp.where(vb, (rb > 0.0).astype(f32), 0.0)    # [QB, G]
+        keyed = jnp.where(vb, -sb, jnp.inf)
+        order = jnp.argsort(keyed, axis=-1)                 # doc at rank
+        ranks = jnp.argsort(order, axis=-1)                 # rank of doc
+        rel_sorted = jnp.take_along_axis(rel, order, axis=-1)
+        invp = 1.0 / jnp.arange(1, G + 1, dtype=f32)        # 1/(p+1)
+        c = jnp.cumsum(rel_sorted, axis=-1)                 # c_p (incl.)
+        T = jnp.cumsum(rel_sorted * invp, axis=-1)          # T_p
+        R = c[:, -1]                                        # [QB]
+        inv_R = jnp.where(R > 0.0, 1.0 / R, 0.0)
+        # per-DOC values at the doc's own rank position
+        C = jnp.take_along_axis(c, ranks, axis=-1)
+        Td = jnp.take_along_axis(T, ranks, axis=-1)
+        P = (ranks + 1).astype(f32)                         # 1-based pos
+
+        def pick(x):                                        # a/b selection
+            xi, xj = x[:, :, None], x[:, None, :]
+            i_first = ranks[:, :, None] < ranks[:, None, :]
+            return (jnp.where(i_first, xi, xj),
+                    jnp.where(i_first, xj, xi))
+
+        rel_a, rel_b = pick(rel)
+        C_a, C_b = pick(C)
+        T_a, T_b = pick(Td)
+        P_a, P_b = pick(P)
+        s = rel_b - rel_a
+        T_bm1 = T_b - rel_b / P_b
+        delta = ((rel_b * (C_a + s) - rel_a * C_a) / P_a
+                 + (rel_a - rel_b) * C_b / P_b
+                 + s * (T_bm1 - T_a))
+        return jnp.abs(delta) * inv_R[:, None, None]
+
+def fold_scale_pos_weight(param, y, weight):
+    """Fold ``param.scale_pos_weight`` into the instance-weight vector.
+
+    XGBoost semantics: positives' grad AND hess scale by the factor —
+    definitionally an instance weight.  THE one implementation, shared
+    by HistGBT and GBLinear (any booster whose param carries the field
+    and an ``objective``), so the two cannot silently diverge.
+    """
+    if param.scale_pos_weight == 1.0:
+        return weight
+    CHECK(param.objective == "binary:logistic",
+          f"scale_pos_weight only applies to binary:logistic "
+          f"(objective is {param.objective!r})")
+    spw = np.where(np.asarray(y) == 1.0,
+                   np.float32(param.scale_pos_weight), np.float32(1.0))
+    return spw if weight is None else np.asarray(weight, np.float32) * spw
+
+
+def _metric_auc(margin, y):
+    """ROC-AUC via the rank-sum (Mann-Whitney) identity with MIDRANKS for
+    ties — GBT margins tie heavily (one tree = ≤2^depth distinct values),
+    and sort-order ranks would score an all-equal round as ~0/1 instead
+    of 0.5.  Degenerate single-class sets return 0.5 (neutral) rather
+    than NaN, which would poison the early-stopping comparison."""
+    s = jnp.sort(margin)
+    lo = jnp.searchsorted(s, margin, side="left")
+    hi = jnp.searchsorted(s, margin, side="right")
+    midrank = (lo + hi + 1) / 2.0                   # 1-based midranks
+    npos = jnp.sum(y)
+    nneg = y.shape[0] - npos
+    denom = npos * nneg
+    auc = (jnp.sum(midrank * y) - npos * (npos + 1) / 2) / jnp.where(
+        denom > 0, denom, 1.0)
+    return jnp.where(denom > 0, auc, 0.5)
+
+
+#: eval_metric name → (fn(margin, y) -> scalar, maximize?)
+EVAL_METRICS = {
+    "logloss": (_Logistic.metric, False),
+    "error": (lambda m, y: jnp.mean((jax.nn.sigmoid(m) > 0.5) != (y > 0.5)),
+              False),
+    "auc": (_metric_auc, True),
+    "rmse": (_SquaredError.metric, False),
+    "mae": (lambda m, y: jnp.mean(jnp.abs(m - y)), False),
+    "mlogloss": (_Softmax.metric, False),
+    "merror": (lambda m, y: jnp.mean(
+        jnp.argmax(m, axis=1) != y.astype(jnp.int32)), False),
+}
+
+#: which metrics make sense for which objective's margin shape
+_METRICS_BY_OBJECTIVE = {
+    "binary:logistic": {"logloss", "error", "auc"},
+    "reg:squarederror": {"rmse", "mae"},
+    "multi:softmax": {"mlogloss", "merror"},
+    # rank eval (ndcg/map) needs qid groups, which EVAL_METRICS'
+    # (margin, y) signature can't see — use models.ranking.ndcg on
+    # predictions instead; in-training eval reports pairwise loss
+    "rank:pairwise": set(),
+    "rank:ndcg": set(),
+    "rank:map": set(),
+}
+

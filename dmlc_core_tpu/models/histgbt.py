@@ -43,7 +43,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG, log_fatal
 from dmlc_core_tpu.base.parameter import Parameter, field
-from dmlc_core_tpu.base.registry import Registry
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.ops.histogram import (build_histogram,
                                          fused_descend_histogram,
@@ -51,10 +50,17 @@ from dmlc_core_tpu.ops.histogram import (build_histogram,
 from dmlc_core_tpu.ops.quantile import (apply_bins, apply_bins_missing,
                                         compute_cuts)
 from dmlc_core_tpu.parallel.mesh import local_mesh
+from dmlc_core_tpu.models.gbt_objectives import (  # noqa: F401  (re-exports:
+    # scripts/tests import these via models.histgbt — keep the names)
+    EVAL_METRICS, OBJECTIVES, _METRICS_BY_OBJECTIVE, _Logistic,
+    _ObjectiveBase, _PairwiseRank, _Softmax, _SquaredError, _metric_auc,
+    fold_scale_pos_weight)
+from dmlc_core_tpu.models.gbt_split import (  # noqa: F401  (re-exports)
+    _advance_node, _host_bin_requested, _host_bin_t, _leaf_sums,
+    _make_best_split, _maybe_l1, _soft_threshold)
+from dmlc_core_tpu.models.histgbt_external import _ExternalMemoryEngine
 
 __all__ = ["HistGBT", "HistGBTParam", "OBJECTIVES"]
-
-OBJECTIVES: Registry = Registry.get("gbt_objective")
 
 #: process-wide compiled round programs, keyed on
 #: :meth:`HistGBT._round_fn_cache_key`.  Entries live for the process
@@ -89,575 +95,6 @@ def _init_margin_fn(mesh: Mesh, shape: tuple, base_score: float,
         out_shardings=sh)
 
 
-class _ObjectiveBase:
-    """Shared objective plumbing: the metric is the mean of per-row
-    losses and the external-memory path's finalizer is the identity —
-    objectives override only where that isn't true (rmse)."""
-
-    @classmethod
-    def metric(cls, pred, y):
-        return jnp.mean(cls.row_loss(pred, y))
-
-    @staticmethod
-    def finalize_mean_loss(m: float) -> float:
-        return m
-
-
-@OBJECTIVES.register("binary:logistic")
-class _Logistic(_ObjectiveBase):
-    """grad/hess of log loss on raw margins; transform = sigmoid."""
-
-    @staticmethod
-    def grad_hess(pred, y):
-        p = jax.nn.sigmoid(pred)
-        return p - y, p * (1.0 - p)
-
-    @staticmethod
-    def transform(pred):
-        return jax.nn.sigmoid(pred)
-
-    @staticmethod
-    def row_loss(pred, y):  # per-row logloss
-        p = jax.nn.sigmoid(pred)
-        eps = 1e-7
-        return -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
-
-
-@OBJECTIVES.register("multi:softmax")
-class _Softmax(_ObjectiveBase):
-    """K-class softmax objective (XGBoost ``multi:softmax``) — margins are
-    [n, K]; grad/hess per class from the full softmax row.  ``predict``
-    returns argmax classes (``multi:softprob`` = same training, transform
-    returns the probability matrix)."""
-
-    @staticmethod
-    def grad_hess(pred, y):                  # pred [n,K], y [n] labels
-        K = pred.shape[1]
-        prob = jax.nn.softmax(pred, axis=1)
-        yoh = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=pred.dtype)
-        return prob - yoh, jnp.maximum(2.0 * prob * (1.0 - prob), 1e-6)
-
-    @staticmethod
-    def transform(pred):                     # class index
-        return jnp.argmax(pred, axis=1).astype(jnp.float32)
-
-    @staticmethod
-    def prob(pred):
-        return jax.nn.softmax(pred, axis=1)
-
-    @staticmethod
-    def row_loss(pred, y):                   # mlogloss
-        logp = jax.nn.log_softmax(pred, axis=1)
-        return -jnp.take_along_axis(
-            logp, y.astype(jnp.int32)[:, None], axis=1)[:, 0]
-
-
-@OBJECTIVES.register("reg:squarederror")
-class _SquaredError(_ObjectiveBase):
-    @staticmethod
-    def grad_hess(pred, y):
-        return pred - y, jnp.ones_like(pred)
-
-    @staticmethod
-    def transform(pred):
-        return pred
-
-    @staticmethod
-    def row_loss(pred, y):  # per-row squared error
-        return (pred - y) ** 2
-
-    @classmethod
-    def metric(cls, pred, y):  # rmse = sqrt of the mean row loss
-        return jnp.sqrt(jnp.mean(cls.row_loss(pred, y)))
-
-    @staticmethod
-    def finalize_mean_loss(m: float) -> float:
-        return float(np.sqrt(m))
-
-
-@OBJECTIVES.register("rank:pairwise")
-class _PairwiseRank(_ObjectiveBase):
-    """RankNet-style pairwise ranking over ``qid`` groups (XGBoost
-    ``rank:pairwise`` — the consumer of the data plane's qid column,
-    reference ``data.h :: Row::qid``, SURVEY.md §2a).
-
-    Contract with :meth:`HistGBT.fit`: rows arrive GROUPED AND PADDED —
-    every query occupies exactly ``group_size`` consecutive rows (pad
-    docs carry ``y = -1`` and weight 0), and shard boundaries fall on
-    group boundaries, so each device's shard is whole groups and the
-    pairwise gradients are shard-local (no cross-device pairs; the
-    histogram psum is the only collective, unchanged).
-
-    Per better-pair (i, j) with rel_i > rel_j inside one group:
-    ``λ = σ(s_j − s_i)``; ``∂L/∂s_i −= λ``, ``∂L/∂s_j += λ``, and both
-    docs accumulate hessian ``λ(1−λ)``.  Groups are processed in
-    ``lax.map`` blocks of ``block_queries`` so the [QB, G, G] pairwise
-    tensors stay a bounded transient instead of O(n·G) at once.
-    """
-
-    is_ranking = True
-
-    def __init__(self, group_size: int, block_queries: int = 256):
-        self.G = int(group_size)
-        self.QB = int(block_queries)
-
-    def _map_blocks(self, pred, y, block_fn):
-        """Shared scaffolding: reshape flat rows into [Q, G] queries, pad
-        the query count to the block multiple (pad queries carry rel −1 →
-        no pairs), and ``lax.map`` over [QB, G] blocks.  ``block_fn``
-        receives the pairwise margin differences ``S[i, j] = s_i − s_j``
-        and the better-pair mask and returns any pytree of per-block
-        results (both the gradients and the loss derive from exactly
-        these two tensors, so padding/sentinel rules live in ONE place).
-        """
-        G = self.G
-        Q = pred.shape[0] // G
-        QB = min(self.QB, Q)
-        qpad = (-Q) % QB
-        s = jnp.pad(pred.reshape(Q, G), ((0, qpad), (0, 0)))
-        r = jnp.pad(y.reshape(Q, G), ((0, qpad), (0, 0)),
-                    constant_values=-1.0)
-
-        def block(args):
-            sb, rb = args                                   # [QB, G]
-            vb = rb >= 0
-            S = sb[:, :, None] - sb[:, None, :]             # s_i − s_j
-            better = ((rb[:, :, None] > rb[:, None, :])
-                      & vb[:, :, None] & vb[:, None, :])
-            return block_fn(S, better)
-
-        nb = (Q + qpad) // QB
-        out = jax.lax.map(block, (s.reshape(nb, QB, G),
-                                  r.reshape(nb, QB, G)))
-        return out, Q
-
-    def grad_hess(self, pred, y):
-        def block_fn(S, better):
-            lam = jnp.where(better, jax.nn.sigmoid(-S), 0.0)
-            rho = lam * (1.0 - lam)
-            g = -lam.sum(axis=2) + lam.sum(axis=1)          # winner/loser
-            h = rho.sum(axis=2) + rho.sum(axis=1)
-            return g, h
-
-        (g, h), Q = self._map_blocks(pred, y, block_fn)
-        G = self.G
-        g = g.reshape(-1, G)[:Q].reshape(Q * G)
-        h = h.reshape(-1, G)[:Q].reshape(Q * G)
-        # docs with no pairs get h=0 → leaf math guards with +lambda, but
-        # keep hessians nonnegative-and-tiny like XGBoost's floor
-        return g, jnp.maximum(h, 1e-16)
-
-    @staticmethod
-    def transform(pred):
-        return pred
-
-    def row_loss(self, pred, y):  # pairwise logloss, averaged per pair
-        log_fatal("rank:pairwise has no per-row loss; use metric()")
-
-    def metric(self, pred, y):
-        """Mean pairwise logistic loss over all better-pairs (same
-        blocked scaffolding as grad_hess — one padding/sentinel rule)."""
-        def block_fn(S, better):
-            return (jnp.where(better, jnp.logaddexp(0.0, -S), 0.0).sum(),
-                    better.sum())
-
-        (losses, counts), _ = self._map_blocks(pred, y, block_fn)
-        return losses.sum() / jnp.maximum(counts.sum(), 1)
-
-
-def _host_bin_requested() -> bool:
-    """True when ``DMLC_TPU_BIN_BACKEND=cpu`` requests host-side numpy
-    binning (unset/empty = bin where the data lives).  Any other value
-    is fatal — historically this knob named a jax backend, and silently
-    routing e.g. ``tpu`` (or a typo) to the single-core host loop would
-    invert the operator's intent.  Through a remote-device tunnel, host
-    binning uploads the 4×-smaller uint8 matrix instead of f32
-    features; see the call sites for the measured trade-offs."""
-    from dmlc_core_tpu.base.parameter import get_env
-
-    backend = get_env("DMLC_TPU_BIN_BACKEND", "", str)
-    if backend in ("", "cpu"):
-        return backend == "cpu"
-    log_fatal(f"DMLC_TPU_BIN_BACKEND={backend!r}: only 'cpu' (host numpy "
-              f"binning) or unset (bin on the data's device) are valid")
-
-
-def fold_scale_pos_weight(param, y, weight):
-    """Fold ``param.scale_pos_weight`` into the instance-weight vector.
-
-    XGBoost semantics: positives' grad AND hess scale by the factor —
-    definitionally an instance weight.  THE one implementation, shared
-    by HistGBT and GBLinear (any booster whose param carries the field
-    and an ``objective``), so the two cannot silently diverge.
-    """
-    if param.scale_pos_weight == 1.0:
-        return weight
-    CHECK(param.objective == "binary:logistic",
-          f"scale_pos_weight only applies to binary:logistic "
-          f"(objective is {param.objective!r})")
-    spw = np.where(np.asarray(y) == 1.0,
-                   np.float32(param.scale_pos_weight), np.float32(1.0))
-    return spw if weight is None else np.asarray(weight, np.float32) * spw
-
-
-def _host_bin_t(X: np.ndarray, cuts_np: np.ndarray,
-                missing: bool = False) -> np.ndarray:
-    """Bin ``X`` on the HOST and return the FEATURE-major bin matrix.
-
-    Pure numpy searchsorted, feature by feature — same semantics as
-    :func:`ops.quantile.apply_bins` (bin = #cuts ≤ value, side='right';
-    uint8 when bins fit; ``missing=True`` sends NaN to the reserved top
-    bin like ``apply_bins_missing``).  Measured 22 s for 10M×28 on one
-    core (r4), replacing the earlier jax-CPU-backend detour, and the
-    per-feature loop never materializes a second full-matrix copy."""
-    miss_bin = cuts_np.shape[1] + 1
-    n_max = miss_bin if missing else cuts_np.shape[1]
-    dtype = np.uint8 if n_max < 256 else np.int32
-    out = np.empty((X.shape[1], len(X)), dtype)
-    for j in range(X.shape[1]):
-        col = np.searchsorted(cuts_np[j], X[:, j],
-                              side="right").astype(dtype)
-        if missing:
-            col[np.isnan(X[:, j])] = miss_bin
-        out[j] = col
-    return out
-
-
-def _soft_threshold(G, alpha: float):
-    """XGBoost's ThresholdL1: shrink the gradient sum toward 0 by the
-    L1 penalty before forming weights/gains."""
-    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
-
-
-def _maybe_l1(G, alpha: float):
-    """The shared alpha gate for LEAF-weight sites: thresholded gradient
-    sum when L1 is on, the raw sum (identical trace) when off.  The
-    split chooser's gain keeps its own gate because its alpha=0 branch
-    must preserve the exact ``G**2`` primitive of the pre-alpha trace."""
-    return _soft_threshold(G, alpha) if alpha > 0.0 else G
-
-
-def _make_best_split(B: int, lam: float, gamma: float, mcw: float,
-                     with_child_sums: bool = False,
-                     mono: Optional[np.ndarray] = None,
-                     missing: bool = False, alpha: float = 0.0):
-    """Greedy per-node split chooser over a gradient histogram.
-
-    hist [2,N,F,B] → (feat [N], thr [N], split_gain [N]); degenerate
-    split (feat 0, thr B-1 → everyone left, gain 0) when gain ≤ gamma.
-    Shared by the in-core shard_map round and the external-memory page
-    loop.
-
-    ``mono`` ([F] ints ∈ {-1, 0, +1}) enables monotone constraints: a
-    candidate split on a constrained feature whose (bound-clipped)
-    optimal child weights violate the required ordering gets gain −inf;
-    the caller passes each node's inherited weight ``bounds`` [N, 2] and
-    propagates them down (see ``grow_tree``), which together with leaf
-    clipping makes the trained function globally monotone.
-
-    ``with_child_sums=True`` additionally returns the children's
-    ``(g_sum, h_sum)`` as ``[2N]`` arrays (leaf order: left=2i,
-    right=2i+1) after the gain.  The cumsum evaluated at the chosen threshold IS the
-    left child's sum and parent − left the right's, so at the deepest
-    level the leaf g/h sums come for free from the histogram — no extra
-    pass over the rows (which an MXU-hostile ``[2,R]·[R,n_leaf]`` scan
-    previously spent ~99% of round time on).
-
-    Precision note: on TPU the histogram multiplies g/h by the one-hots
-    in bf16 (f32 accumulation), so leaf sums carry ~1e-3 relative
-    rounding per entry rather than being bit-identical to the CPU
-    segment-sum path.  Split selection always had this property (gain is
-    computed from the same histogram); extending it to leaf weights is
-    the deliberate price of eliminating the dominant per-round pass.
-
-    ``missing=True`` (XGBoost's learned default direction; exclusive
-    with ``mono``, CHECKed at fit): bin ``B-1`` is reserved for NaN
-    rows (``apply_bins_missing``), value bins are ``0..B-2``.  Every
-    candidate threshold's gain is evaluated with the node's missing
-    mass on the left AND the right (the missing-right branch is
-    numerically the plain formula — value cumsums exclude bin B-1,
-    totals include it, so NaN-free nodes reduce exactly to the
-    unconstrained scan), and the better direction is recorded per node
-    as ``dir`` (1 = missing left), returned between thr and gain.
-    Degenerate nodes keep thr = B-1 / dir = 1: every row, missing
-    included, goes left.
-    """
-    CHECK(mono is None or not missing,
-          "monotone constraints are not supported with missing=True "
-          "(the constrained-gain branch has no missing-direction form)")
-
-    def best_split(hist, feat_mask=None, bounds=None):
-        g = hist[0]
-        h = hist[1]
-        cg = jnp.cumsum(g, axis=-1)                  # [N,F,B] left-incl. sums
-        ch = jnp.cumsum(h, axis=-1)
-        gl = cg[..., :-1]                            # [N,F,B-1] left: bin ≤ b
-        hl = ch[..., :-1]
-        gt = cg[..., -1:]                            # [N,F,1]
-        ht = ch[..., -1:]
-        if alpha > 0.0:
-            # XGBoost alpha: gain term T(G)²/(H+λ) with the
-            # soft-thresholded gradient sum (gated so alpha=0 keeps the
-            # exact pre-alpha trace)
-            def _score(G, H):
-                t = _soft_threshold(G, alpha)
-                return t * t / (H + lam)
-        else:
-            def _score(G, H):
-                return G**2 / (H + lam)
-        dir_l = None
-        if missing:
-            miss_g = g[..., B - 1]                   # [N,F] NaN-bin mass
-            miss_h = h[..., B - 1]
-
-            def side_gain(gl_, hl_):
-                gr_ = gt - gl_
-                hr_ = ht - hl_
-                gn = (_score(gl_, hl_) + _score(gr_, hr_)
-                      - _score(gt, ht))
-                ok_ = (hl_ >= mcw) & (hr_ >= mcw)
-                return jnp.where(ok_, gn, -jnp.inf)
-
-            gain_r = side_gain(gl, hl)               # missing → right
-            gain_l = side_gain(gl + miss_g[..., None],
-                               hl + miss_h[..., None])
-            gain = jnp.maximum(gain_r, gain_l)
-            dir_l = gain_l > gain_r                  # [N,F,B-1] bool
-        else:
-            gr = gt - gl
-            hr = ht - hl
-            gain = (_score(gl, hl) + _score(gr, hr) - _score(gt, ht))
-        if mono is not None:
-            # bounds bind the REALIZABLE child weights, so gain must be
-            # evaluated at the clipped weights (XGBoost's constrained
-            # gain) — the closed form above assumes unclipped optima and
-            # would rank clipped splits by value they cannot achieve.
-            # For (-inf, inf) bounds this reduces exactly to the closed
-            # form: obj(w*) = -G²/2(H+λ), gain = 2·Δobj.
-            wl = -gl / (hl + lam)                    # candidate child weights
-            wr = -gr / (hr + lam)
-            wp = -gt / (ht + lam)
-            if bounds is not None:                   # inherited node bounds
-                lo = bounds[:, 0][:, None, None]
-                hi = bounds[:, 1][:, None, None]
-                wl = jnp.clip(wl, lo, hi)
-                wr = jnp.clip(wr, lo, hi)
-                wp = jnp.clip(wp, lo, hi)
-
-            def objv(G, H, w):
-                return G * w + 0.5 * (H + lam) * w * w
-
-            gain = 2.0 * (objv(gt, ht, wp) - objv(gl, hl, wl)
-                          - objv(gr, hr, wr))
-            m = jnp.asarray(mono)[None, :, None]     # [1, F, 1]
-            viol = ((m > 0) & (wl > wr)) | ((m < 0) & (wl < wr))
-            gain = jnp.where(viol, -jnp.inf, gain)
-        if not missing:                  # missing folds mcw per direction
-            ok = (hl >= mcw) & (hr >= mcw)
-            gain = jnp.where(ok, gain, -jnp.inf)
-        if feat_mask is not None:                    # colsample: [F] bool
-            gain = jnp.where(feat_mask[None, :, None], gain, -jnp.inf)
-        flat = gain.reshape(gain.shape[0], -1)       # [N, F*(B-1)]
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        feat = (best // (B - 1)).astype(jnp.int32)
-        thr = (best % (B - 1)).astype(jnp.int32)
-        split_ok = 0.5 * best_gain > gamma
-        feat = jnp.where(split_ok, feat, 0)
-        thr = jnp.where(split_ok, thr, B - 1)        # bins ≤ B-1 → all left
-        if missing:
-            dirv = jnp.take_along_axis(
-                dir_l.reshape(dir_l.shape[0], -1), best[:, None],
-                axis=1)[:, 0].astype(jnp.int32)
-            dirv = jnp.where(split_ok, dirv, 1)      # degenerate: all left
-        # XGBoost's reported split gain (0 for degenerate nodes) — kept in
-        # the tree arrays so importance_type="gain" costs nothing extra
-        split_gain = jnp.where(split_ok, 0.5 * best_gain, 0.0)
-        if not with_child_sums:
-            return ((feat, thr, dirv, split_gain) if missing
-                    else (feat, thr, split_gain))
-        N, F = g.shape[0], g.shape[1]
-        n_idx = jnp.arange(N, dtype=jnp.int32)
-        flat_idx = (n_idx * F + feat) * B + thr
-        lg = cg.reshape(-1)[flat_idx]                # left-child sums [N]
-        lh = ch.reshape(-1)[flat_idx]
-        if missing:
-            mg = miss_g.reshape(-1)[n_idx * F + feat]
-            mh = miss_h.reshape(-1)[n_idx * F + feat]
-            # degenerate thr = B-1 already includes the missing bin in
-            # its cumsum; adding mg again would double-count it
-            add_miss = (dirv == 1) & (thr < B - 1)
-            lg = lg + jnp.where(add_miss, mg, 0.0)
-            lh = lh + jnp.where(add_miss, mh, 0.0)
-        tg = cg[:, 0, -1]                            # node totals (any feature)
-        th_ = ch[:, 0, -1]
-        child_g = jnp.stack([lg, tg - lg], axis=1).reshape(2 * N)
-        child_h = jnp.stack([lh, th_ - lh], axis=1).reshape(2 * N)
-        if missing:
-            return feat, thr, dirv, split_gain, child_g, child_h
-        return feat, thr, split_gain, child_g, child_h
-
-    return best_split
-
-
-# -- external-memory page kernels (jitted once per page shape) --------------
-
-@jax.jit
-def _advance_node(bins_t, node, feat, thr):
-    """Route rows one level down the tree; padding rows (node<0) stay -1.
-    ``bins_t`` is feature-major [F, n]; the selected feature's bin comes
-    from ops.select_feature_bins (shared gather-free select)."""
-    valid = node >= 0
-    safe = jnp.where(valid, node, 0)
-    row_bin = select_feature_bins(bins_t, feat[safe])
-    nxt = 2 * safe + (row_bin > thr[safe]).astype(jnp.int32)
-    return jnp.where(valid, nxt, -1)
-
-
-@partial(jax.jit, static_argnums=(3,))
-def _leaf_sums(node, g, h, n_leaf):
-    safe = jnp.where(node >= 0, node, 0)  # padding rows carry g=h=0
-    return (jax.ops.segment_sum(g, safe, num_segments=n_leaf),
-            jax.ops.segment_sum(h, safe, num_segments=n_leaf))
-
-
-# -- chunked external-memory round pieces -----------------------------------
-# Module-level jits (config via static args) so jax.jit's cache — keyed on
-# function identity + statics + shapes — carries compiled programs across
-# fits and across HistGBT instances; defined as per-fit closures they
-# recompiled every call (~2·depth+5 programs, seconds each on a 1-core
-# host, minutes through a remote-compile tunnel).
-
-@partial(jax.jit, static_argnames=("obj", "multiclass"))
-def _ext_gh(preds, y, wk, *, obj, multiclass):
-    g, h = obj.grad_hess(preds, y)
-    w_col = wk[:, None] if multiclass else wk
-    return g * w_col, h * w_col
-
-
-@partial(jax.jit, static_argnames=("level", "col", "B", "method"))
-def _ext_adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev, *,
-                      level, col, B, method):
-    """Advance nodes one level (using the PREVIOUS level's split, level 0
-    skips it) then build this level's histogram — fused so a streamed
-    chunk's bins upload is consumed ONCE per level, not once for hist and
-    again for advance."""
-    if level > 0:
-        node = _advance_node(bins, node, feat_prev, thr_prev)
-    g_c = g if col is None else g[:, col]
-    h_c = h if col is None else h[:, col]
-    n_nodes = 1 << level
-    n_build = 1 if level == 0 else n_nodes >> 1
-    nd = node
-    if level > 0:
-        nd = jnp.where((nd >= 0) & (nd % 2 == 0), nd >> 1, -1)
-    return node, build_histogram(bins, nd, g_c, h_c, n_build, B,
-                                 method, transposed=True)
-
-
-@partial(jax.jit, static_argnames=("n_leaf",))
-def _ext_final_adv_leaf(bins, node, g_c, h_c, feat, thr, *, n_leaf):
-    """Last advance (deepest split) fused with the leaf g/h sums — again
-    one bins consumption for the level."""
-    node = _advance_node(bins, node, feat, thr)
-    gs, hs = _leaf_sums(node, g_c, h_c, n_leaf)
-    return node, gs, hs
-
-
-@partial(jax.jit, static_argnames=("level", "B"))
-def _ext_sib_stack(hist, prev_hist, *, level, B):
-    n_nodes = 1 << level
-    return jnp.stack([hist, prev_hist - hist], axis=2).reshape(
-        2, n_nodes, hist.shape[2], B)
-
-
-@lru_cache(maxsize=64)
-def _ext_split_fn(B, lam, gamma, mcw, alpha=0.0):
-    return jax.jit(_make_best_split(B, lam, gamma, mcw, alpha=alpha))
-
-
-@partial(jax.jit, static_argnames=("col", "n_leaf"))
-def _ext_upd_preds(preds, node, leaf, *, col, n_leaf):
-    gain = leaf[jnp.clip(node, 0, n_leaf - 1)]
-    if col is None:
-        return preds + gain
-    return preds.at[:, col].add(gain)
-
-
-@partial(jax.jit, static_argnames=("lam", "eta", "alpha"))
-def _ext_leaf_calc(gsum, hsum, *, lam, eta, alpha=0.0):
-    return (-_maybe_l1(gsum, alpha) / (hsum + lam)
-            * eta).astype(jnp.float32)
-
-
-@partial(jax.jit, static_argnames=("half",))
-def _ext_pack_tree(feats, thrs, gains, leaf, *, half):
-    """One flat f32 array per tree → ONE host fetch (feat/thr are small
-    ints, exact in f32)."""
-    fp = jnp.concatenate([jnp.pad(f, (0, half - f.shape[0]))
-                          for f in feats]).astype(jnp.float32)
-    tp = jnp.concatenate([jnp.pad(t, (0, half - t.shape[0]))
-                          for t in thrs]).astype(jnp.float32)
-    gp = jnp.concatenate([jnp.pad(g, (0, half - g.shape[0]))
-                          for g in gains])
-    return jnp.concatenate([fp, tp, gp, leaf])
-
-
-@partial(jax.jit, static_argnames=("nv", "obj"))
-def _ext_eval_loss(preds, y, *, nv, obj):
-    return jnp.sum(obj.row_loss(preds[:nv], y[:nv]))
-
-
-@lru_cache(maxsize=256)
-def _ext_const_fn(shape, fill, dtype_name):
-    """Cached jitted constant-fill (init margins / zero node vectors);
-    shape-keyed and bounded like :func:`_init_margin_fn`."""
-    dtype = np.dtype(dtype_name)
-    return jax.jit(lambda: jnp.full(shape, fill, dtype))
-
-
-def _metric_auc(margin, y):
-    """ROC-AUC via the rank-sum (Mann-Whitney) identity with MIDRANKS for
-    ties — GBT margins tie heavily (one tree = ≤2^depth distinct values),
-    and sort-order ranks would score an all-equal round as ~0/1 instead
-    of 0.5.  Degenerate single-class sets return 0.5 (neutral) rather
-    than NaN, which would poison the early-stopping comparison."""
-    s = jnp.sort(margin)
-    lo = jnp.searchsorted(s, margin, side="left")
-    hi = jnp.searchsorted(s, margin, side="right")
-    midrank = (lo + hi + 1) / 2.0                   # 1-based midranks
-    npos = jnp.sum(y)
-    nneg = y.shape[0] - npos
-    denom = npos * nneg
-    auc = (jnp.sum(midrank * y) - npos * (npos + 1) / 2) / jnp.where(
-        denom > 0, denom, 1.0)
-    return jnp.where(denom > 0, auc, 0.5)
-
-
-#: eval_metric name → (fn(margin, y) -> scalar, maximize?)
-EVAL_METRICS = {
-    "logloss": (_Logistic.metric, False),
-    "error": (lambda m, y: jnp.mean((jax.nn.sigmoid(m) > 0.5) != (y > 0.5)),
-              False),
-    "auc": (_metric_auc, True),
-    "rmse": (_SquaredError.metric, False),
-    "mae": (lambda m, y: jnp.mean(jnp.abs(m - y)), False),
-    "mlogloss": (_Softmax.metric, False),
-    "merror": (lambda m, y: jnp.mean(
-        jnp.argmax(m, axis=1) != y.astype(jnp.int32)), False),
-}
-
-#: which metrics make sense for which objective's margin shape
-_METRICS_BY_OBJECTIVE = {
-    "binary:logistic": {"logloss", "error", "auc"},
-    "reg:squarederror": {"rmse", "mae"},
-    "multi:softmax": {"mlogloss", "merror"},
-    # rank eval (ndcg/map) needs qid groups, which EVAL_METRICS'
-    # (margin, y) signature can't see — use models.ranking.ndcg on
-    # predictions instead; in-training eval reports pairwise loss
-    "rank:pairwise": set(),
-}
-
 
 class HistGBTParam(Parameter):
     """Hyperparameters (XGBoost-compatible names where they exist)."""
@@ -675,7 +112,8 @@ class HistGBTParam(Parameter):
     min_child_weight = field(float, default=1.0, lower_bound=0.0)
     objective = field(str, default="binary:logistic",
                       enum=["binary:logistic", "reg:squarederror",
-                            "multi:softmax", "rank:pairwise"])
+                            "multi:softmax", "rank:pairwise",
+                            "rank:ndcg", "rank:map"])
     max_group_size = field(int, default=0, lower_bound=0,
                            description="rank:pairwise — cap docs per "
                                        "query (0 = largest group; larger "
@@ -706,7 +144,7 @@ class HistGBTParam(Parameter):
                         description="histogram engine (ops.histogram)")
 
 
-class HistGBT:
+class HistGBT(_ExternalMemoryEngine):
     """Train/predict API.
 
     ``mesh`` may be any Mesh with a ``data`` axis (default: 1-axis mesh
@@ -805,18 +243,18 @@ class HistGBT:
         X = np.ascontiguousarray(X, dtype=np.float32)
         y = np.ascontiguousarray(y, dtype=np.float32)
         self._rank_pos = None
-        if p.objective == "rank:pairwise":
-            CHECK(qid is not None, "rank:pairwise needs qid=")
+        if p.objective.startswith("rank:"):
+            CHECK(qid is not None, f"{p.objective} needs qid=")
             CHECK(eval_set is None,
-                  "rank:pairwise eval_set not supported (metrics need "
+                  f"{p.objective} eval_set not supported (metrics need "
                   "qid groups; use models.ranking.ndcg on predictions)")
             CHECK(len(self.trees) == 0,
-                  "rank:pairwise continued fit not supported (padded "
+                  f"{p.objective} continued fit not supported (padded "
                   "layout is per-fit)")
             X, y, weight = self._regroup_ranking(X, y, np.asarray(qid),
                                                  weight)
         else:
-            CHECK(qid is None, f"qid= only valid for rank:pairwise "
+            CHECK(qid is None, f"qid= only valid for rank objectives "
                   f"(objective is {p.objective!r})")
         n, F = X.shape
         CHECK_EQ(len(y), n, "X/y row mismatch")
@@ -867,15 +305,22 @@ class HistGBT:
             y_d = jax.device_put(y, row_sharding)
             w_d = jax.device_put(mask, row_sharding)
             margin_shape = self._margin_shape(n + n_pad)
-            init_margin = np.asarray(self._apply_trees(
+            # the margin replay stays ON DEVICE: a host round trip here
+            # (the pre-r5 code) cannot even fetch the value when the
+            # mesh spans processes (non-addressable shards) — the
+            # elastic-recovery resume path is exactly that case.  The
+            # base margin is laid out with the target sharding so the
+            # replayed margins inherit it by propagation.
+            tgt_sharding = mat_sharding if K_cls > 1 else row_sharding
+            preds = self._apply_trees(
                 bins, self._stacked_trees(self.trees),
-                jnp.full(margin_shape, p.base_score, jnp.float32))
-            ).astype(np.float32)
-            bins.delete()
+                jax.device_put(np.full(margin_shape, p.base_score,
+                                       np.float32), tgt_sharding))
+            if preds.sharding != tgt_sharding:
+                preds = jax.device_put(preds, tgt_sharding)
+            preds.block_until_ready()      # bins feed the replay; only
+            bins.delete()                  # delete after it completes
             del bins
-            preds = jax.device_put(
-                init_margin,
-                mat_sharding if K_cls > 1 else row_sharding)
         else:
             # a FRESH fit() always re-derives cuts from this X (the
             # pre-refactor contract): leftovers from an aborted fit or
@@ -991,9 +436,9 @@ class HistGBT:
         pos[rows_all] = dst_all
         truncated = int(n - kept.sum())
         if truncated:
-            LOG("WARNING", "rank:pairwise: truncated %d docs beyond "
-                "max_group_size=%d", truncated, G)
-        self._obj = _PairwiseRank(G)
+            LOG("WARNING", "%s: truncated %d docs beyond "
+                "max_group_size=%d", p.objective, truncated, G)
+        self._obj = OBJECTIVES[p.objective](G)
         self._rank_pos = pos
         return Xp, yp, wp
 
@@ -1315,8 +760,8 @@ class HistGBT:
         emission rides this).
         """
         p = self.param
-        CHECK(p.objective != "rank:pairwise",
-              "fit_device does not support rank:pairwise (padded layout "
+        CHECK(not p.objective.startswith("rank:"),
+              f"fit_device does not support {p.objective} (padded layout "
               "is per-fit); use fit(qid=...)")
         self.trees = []
         self.best_iteration = None
@@ -1335,517 +780,6 @@ class HistGBT:
     # ------------------------------------------------------------------
     # external-memory training (BASELINE config 3)
     # ------------------------------------------------------------------
-    def fit_external(
-        self,
-        row_iter,
-        num_col: Optional[int] = None,
-        eval_every: int = 0,
-        sketch_pages: int = 32,
-        cuts: Optional[jax.Array] = None,
-        cache_device: bool = False,
-        warmup_rounds: int = 0,
-    ) -> "HistGBT":
-        """Out-of-core boosting over a :class:`RowBlockIter` (sparse CSR
-        pages from a Parser/DiskRowIter — the Criteo-scale path).
-
-        Never materializes the dataset: pass 1 streams pages through a
-        bounded-memory :class:`SketchAccumulator` (the fixed-size sketch
-        "allreduce" replacing the reference world's variable-size rabit
-        sketch merge); pass 2 bins each page to uint8 (4× smaller than
-        raw f32, the only per-row state kept); each round then rescans
-        binned pages level-by-level, accumulating node histograms on
-        device and allreducing across workers.  Missing CSR entries bin
-        as 0.0 (XGBoost's dense-hist convention for Criteo-style data).
-
-        Trees produced are the same arrays as :meth:`fit`, so
-        :meth:`predict` and checkpointing work unchanged.
-
-        Device memory contract: bounded by
-        ``DMLC_TPU_EXTERNAL_DEVICE_BUDGET`` (bytes, default 6 GiB).
-        When the whole binned set + per-row state fit the budget (and no
-        sampling is active — see below) the in-core chunked engine runs
-        (identical splits, ~25 rounds per dispatch); otherwise the
-        chunk-streaming engine re-uploads bins per level while per-row
-        state (y/w/preds/g/h/node, 12+12·num_class B/row) stays
-        resident — that row-state floor is the engine's minimum
-        residency, so datasets beyond ``budget/(12+12K)`` rows must
-        shard across workers (PARITY.md §2b records this trade against
-        the r3 per-page mode, whose unbounded-rows promise cost
-        O(pages·depth) host-synced dispatches per round).
-
-        ``cache_device=True`` forces full residency regardless of the
-        budget.  Single-worker cache_device runs the in-core chunked
-        engine: identical splits; leaf values carry the histogram-cumsum
-        precision note, and with ``subsample``/``colsample_bytree`` < 1
-        the *random draws* come from the device PRNG instead of the
-        streaming engine's numpy PRNG, so the same seed selects a
-        different (equally distributed) sample across the two modes.
-        The DEFAULT path never has that ambiguity: with sampling active
-        it always uses the streaming engine's numpy draws, whatever the
-        dataset size.
-        """
-        from dmlc_core_tpu.ops.quantile import SketchAccumulator
-        from dmlc_core_tpu.parallel import collectives as coll
-
-        p = self.param
-        CHECK(not (p.monotone_constraints
-                   and any(int(v) for v in p.monotone_constraints)),
-              "fit_external: monotone_constraints not supported — use fit()")
-        CHECK(p.objective != "rank:pairwise",
-              "fit_external: rank:pairwise needs the grouped in-core "
-              "layout — use fit(X, y, qid=...)")
-        CHECK(not self._missing,
-              "fit_external: this model was trained in missing mode "
-              "(NaN bin + learned directions); the streaming engine "
-              "builds standard cuts and would silently misread the top "
-              "value bin as missing mass — continue with fit(), or use "
-              "a fresh model")
-        if p.scale_pos_weight != 1.0:
-            # fail BEFORE the full-dataset sketch pass, not per page
-            CHECK(p.objective == "binary:logistic",
-                  f"scale_pos_weight only applies to binary:logistic "
-                  f"(objective is {p.objective!r})")
-        B = p.n_bins
-
-        # -- pass 1: streaming sketch --------------------------------------
-        F = max(num_col or 0, row_iter.num_col)
-        if coll.world_size() > 1:
-            # sparse shards can disagree on the max feature index; the
-            # sketch allgather and histogram allreduce need one global F
-            # (reference world: rabit allreduce-max of num_col)
-            F = int(coll.allreduce(np.asarray([F], np.int64), op="max")[0])
-        CHECK(F > 0, "fit_external: empty input")
-        if cuts is not None:
-            self.cuts = cuts
-        else:
-            sketch: Optional[SketchAccumulator] = None
-            for block in row_iter:
-                X = block.to_dense(F)
-                if sketch is None:
-                    sketch = SketchAccumulator(F, n_summary=max(8 * B, 64),
-                                               buffer_pages=sketch_pages)
-                # scaled weights here too: the cuts an explicit weight
-                # vector would produce and the spw cuts must match
-                sketch.add(X, self._fold_scale_pos_weight(
-                    block.label, block.weight))
-            CHECK(sketch is not None, "fit_external: empty input")
-            self.cuts = sketch.finalize(B, allgather_fn=self._maybe_allgather())
-
-        # -- pass 2: bin pages (uint8, FEATURE-major like fit()) -----------
-        K_cls = p.num_class
-        pages: List[Dict[str, Any]] = []   # "bins" is a jax.Array when cache_device
-        # DMLC_TPU_BIN_BACKEND=cpu (see _host_bin_requested) bins pages on
-        # the host backend and uploads nothing per page: through a
-        # remote-device tunnel, 365 per-page f32 uploads cost seconds
-        # each, while the cached path re-uploads the 4x-smaller uint8
-        # matrix ONCE at concat time.  On a locally attached chip leave
-        # it unset (device binning).
-        host_bin = _host_bin_requested()
-        cuts_for_bin = np.asarray(self.cuts) if host_bin else None
-        for block in row_iter:
-            X = block.to_dense(F)
-            # in pass 2 so it runs on the explicit-cuts path too (pass 1
-            # is skipped there): plain searchsorted would silently alias
-            # NaN into the top value bin
-            CHECK(not np.isnan(X).any(),
-                  "fit_external: NaN features are only supported by "
-                  "the in-core fit (learned missing direction) — "
-                  "impute before streaming, or fit in-core")
-            if host_bin:
-                bins = _host_bin_t(X, cuts_for_bin)
-            else:
-                bins = apply_bins(jnp.asarray(X), self.cuts).T  # [F, rows]
-                if not cache_device:
-                    bins = np.asarray(bins)  # spill to host; one page on
-                                             # device at a time (out-of-core)
-            w = (np.asarray(block.weight, np.float32)
-                 if block.weight is not None else np.ones(len(X), np.float32))
-            w = self._fold_scale_pos_weight(
-                np.asarray(block.label, np.float32), w)
-            pages.append({
-                "bins": bins,
-                "y": np.asarray(block.label, np.float32),
-                "w": w,
-            })
-        if K_cls > 1:
-            for pg in pages:
-                if len(pg["y"]):   # empty shard pages are legal
-                    CHECK(pg["y"].min() >= 0 and pg["y"].max() < K_cls,
-                          f"multi:softmax labels must be in [0, {K_cls})")
-
-        distributed = coll.world_size() > 1
-        if cache_device and not distributed:
-            return self._fit_external_cached(pages, F, eval_every,
-                                             warmup_rounds)
-        # auto-residency (VERDICT r3 #3): when the binned data + per-row
-        # state + the cached engine's concat transient fit the device
-        # budget, the streaming loop would be pure dispatch overhead —
-        # route to the in-core engine (identical splits, ~25 rounds per
-        # dispatch).  The budget knob keeps the bounded-memory promise
-        # explicit instead of implicit-per-page.  With sampling active
-        # the chunked engine runs even under budget: the cached engine
-        # draws from the device PRNG, and auto-routing would make the
-        # same seed's sampled rows depend on dataset size vs budget —
-        # the chunked engine reproduces the page-stream numpy draws at
-        # any size.
-        N_total = sum(len(pg["y"]) for pg in pages)
-        from dmlc_core_tpu.base.parameter import get_env
-        budget = get_env("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", 6 << 30, int)
-        row_state = 12 + 12 * K_cls          # y/w/node + preds/g/h per class
-        no_sampling = p.subsample >= 1.0 and p.colsample_bytree >= 1.0
-        if (not distributed and no_sampling
-                and N_total * (2 * F + row_state) <= budget):
-            LOG("INFO", "fit_external: %d rows x %d feats fit the device "
-                "budget (%d MiB; DMLC_TPU_EXTERNAL_DEVICE_BUDGET) - using "
-                "the device-cached engine", N_total, F, budget >> 20)
-            return self._fit_external_cached(pages, F, eval_every,
-                                             warmup_rounds)
-        return self._fit_external_chunked(pages, F, eval_every, distributed,
-                                          budget=budget,
-                                          cache_all=cache_device,
-                                          warmup_rounds=warmup_rounds)
-
-    def _fit_external_cached(self, pages, F: int, eval_every: int,
-                             warmup_rounds: int = 0) -> "HistGBT":
-        """Device-cached external-memory training = the in-core engine.
-
-        With the binned pages resident in HBM there is nothing
-        out-of-core left per round, so the pages concatenate into one
-        feature-major bin matrix and boosting runs through the same
-        chunked-scan machinery as :meth:`fit` — ONE dispatch per ~25
-        rounds instead of O(pages·depth) host-driven dispatches per
-        round (which a remote-device tunnel turns into seconds of
-        latency per round).
-
-        Memory note: the page concatenation transiently needs ~2× the
-        binned matrix in HBM (sources + destination) before the page
-        refs drop; steady-state residency equals the page loop's.  If
-        that transient doesn't fit, use ``cache_device=False``.
-        """
-        p = self.param
-        ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
-        y = np.concatenate([pg["y"] for pg in pages])
-        w = np.concatenate([pg["w"] for pg in pages])
-        n = len(y)
-        n_pad = (-n) % ndev
-        if isinstance(pages[0]["bins"], np.ndarray):
-            # host pages (auto-residency route): concatenate on host so
-            # the device sees ONE upload, not one per page — a remote
-            # tunnel charges per-transfer latency ~365 times otherwise
-            bins_t = jnp.asarray(
-                np.concatenate([pg["bins"] for pg in pages], axis=1))
-        else:
-            bins_t = jnp.concatenate(
-                [jnp.asarray(pg["bins"]) for pg in pages], axis=1)
-        pages.clear()                     # free the per-page device refs
-        if n_pad:
-            bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad)))
-            y = np.concatenate([y, np.zeros(n_pad, np.float32)])
-            w = np.concatenate([w, np.zeros(n_pad, np.float32)])
-        row_sharding = NamedSharding(self.mesh, P("data"))
-        bins_t = jax.device_put(
-            bins_t, NamedSharding(self.mesh, P(None, "data")))
-        y_d = jax.device_put(y, row_sharding)
-        w_d = jax.device_put(w, row_sharding)
-        preds = jax.device_put(
-            np.full(self._margin_shape(n + n_pad), p.base_score, np.float32),
-            NamedSharding(self.mesh, P("data", None))
-            if p.num_class > 1 else row_sharding)
-
-        preds = self._boost_binned(bins_t, y_d, w_d, preds, F,
-                                   eval_every=eval_every,
-                                   warmup_rounds=warmup_rounds)
-        # same post-fit contract as fit(): train_margins() works after a
-        # cache_device external fit too (padding sliced off by the
-        # recorded real-row count)
-        self._train_preds = preds
-        self._n_real_rows = n
-        return self
-
-    def _fit_external_chunked(self, pages, F: int, eval_every: int,
-                              distributed: bool, budget: int,
-                              cache_all: bool = False,
-                              warmup_rounds: int = 0) -> "HistGBT":
-        """Bounded-device-memory boosting over page-stacked chunks.
-
-        Replaces the r3 per-page loop, which paid O(pages·depth)
-        host-SYNCED device round-trips per boosting round (each ~100 ms+
-        through a remote-device tunnel → 658 s/round at 1M rows).  The
-        restructure (VERDICT r3 #3; reference seam: disk_row_iter.h's
-        page-cached training loop, SURVEY.md §2b):
-
-        * pages concatenate into a handful of fixed-shape chunks sized
-          so ONE chunk's bins plus the always-resident per-row state
-          (y/w/preds/g/h/node, 12+12K B/row) fit
-          ``DMLC_TPU_EXTERNAL_DEVICE_BUDGET``; non-resident chunk bins
-          re-upload per level (the out-of-core price), asynchronously;
-        * every per-level product — node histograms, split choice, node
-          routing, leaf sums, margin updates — stays on device; the only
-          host sync is ONE packed fetch per finished tree;
-        * per round: O(depth·chunks) asynchronous dispatches, zero
-          intermediate host syncs (vs O(pages·depth) synced fetches).
-
-        Sampling reproduces the r3 page loop's draws exactly: colsample
-        masks use the same [seed, round, 1] host RNG; subsample keep
-        masks draw per page in stream order from the same
-        [seed, round, 2, rank] RNG before concatenating into chunks.
-
-        Trees/predict/checkpoint contracts match :meth:`fit`.  Like the
-        r3 page loop, ``_train_preds`` is not retained.
-        """
-        from dmlc_core_tpu.parallel import collectives as coll
-
-        p = self.param
-        obj = self._obj
-        B, depth, K_cls = p.n_bins, p.max_depth, p.num_class
-        n_leaf = 1 << depth
-        half = max(n_leaf >> 1, 1)
-        method = p.hist_method
-
-        # -- chunk sizing against the device budget ---------------------
-        page_rows = [len(pg["y"]) for pg in pages]
-        N = sum(page_rows)
-        CHECK(N > 0, "fit_external: no rows")
-        row_state = 12 + 12 * K_cls
-        if cache_all:
-            # cache_device=True overrides the budget by contract (the
-            # budget CHECK must not kill a forced-residency request)
-            rows_per_chunk = N
-        else:
-            avail_bins = budget - N * row_state
-            CHECK(avail_bins > F,
-                  f"DMLC_TPU_EXTERNAL_DEVICE_BUDGET={budget} cannot hold "
-                  f"the always-resident per-row state ({N} rows x "
-                  f"{row_state} B = {N * row_state} B) plus one row of "
-                  f"bins.  Raise the budget toward the chip's HBM, shard "
-                  f"rows across more workers (each worker's floor is its "
-                  f"own shard only), or force residency with "
-                  f"cache_device=True.  This floor is the documented "
-                  f"trade vs the r3 per-page mode — see fit_external "
-                  f"docstring / PARITY.md §2b")
-            rows_per_chunk = min(N, max(int(avail_bins // F), 1))
-        n_chunks = -(-N // rows_per_chunk)
-        Rc = -(-N // n_chunks)
-        Rc = -(-Rc // 128) * 128            # lane-aligned fixed shape
-        n_chunks = -(-N // Rc)              # rounding may empty the tail
-        resident = n_chunks == 1
-
-        # -- stack pages into chunk arrays, then free the pages ---------
-        # device pages (distributed cache_device: pass 2 binned on
-        # device) concatenate ON device — downloading them per page just
-        # to re-upload would cost a blocked D2H fetch each
-        device_pages = pages and not isinstance(pages[0]["bins"],
-                                                np.ndarray)
-        if device_pages:
-            CHECK(n_chunks == 1,
-                  "device-resident pages require cache_device residency")
-            stacked = jnp.concatenate([pg["bins"] for pg in pages], axis=1)
-            bins_d = [jnp.pad(stacked, ((0, 0), (0, Rc - N)))]
-            bins_h = None
-        else:
-            bins_h = np.zeros((n_chunks, F, Rc), np.uint8)
-        y_h = np.zeros((n_chunks, Rc), np.float32)
-        w_h = np.zeros((n_chunks, Rc), np.float32)   # pad rows weigh 0
-        pos = 0
-        for pg in pages:
-            r = len(pg["y"])
-            done = 0
-            while done < r:
-                c, off = divmod(pos, Rc)
-                take = min(r - done, Rc - off)
-                if bins_h is not None:
-                    bins_h[c, :, off:off + take] = \
-                        pg["bins"][:, done:done + take]
-                y_h[c, off:off + take] = pg["y"][done:done + take]
-                w_h[c, off:off + take] = pg["w"][done:done + take]
-                done += take
-                pos += take
-        n_valid = [max(0, min(Rc, N - c * Rc)) for c in range(n_chunks)]
-        pages.clear()
-
-        # -- device-resident per-row state ------------------------------
-        y_d = [jnp.asarray(y_h[c]) for c in range(n_chunks)]
-        w_d = [jnp.asarray(w_h[c]) for c in range(n_chunks)]
-        mshape = (Rc, K_cls) if K_cls > 1 else (Rc,)
-        init_margin = _ext_const_fn(mshape, p.base_score, "float32")
-        preds_d = [init_margin() for _ in range(n_chunks)]
-        zeros_node = _ext_const_fn((Rc,), 0, "int32")()
-        if not device_pages:
-            bins_d = ([jnp.asarray(bins_h[c]) for c in range(n_chunks)]
-                      if resident else None)
-
-        def chunk_bins(c):
-            return bins_d[c] if bins_d is not None else jnp.asarray(bins_h[c])
-
-        # -- round pieces: module-level jits (_ext_*) bound to this fit's
-        # config via static kwargs, so compiled programs persist across
-        # fits/instances in jax.jit's own cache
-        gh_fn = partial(_ext_gh, obj=obj, multiclass=K_cls > 1)
-
-        def adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev, level, col):
-            return _ext_adv_hist_lvl(bins, node, g, h, feat_prev, thr_prev,
-                                     level=level, col=col, B=B,
-                                     method=method)
-
-        final_adv_leaf = partial(_ext_final_adv_leaf, n_leaf=n_leaf)
-        sib_stack = partial(_ext_sib_stack, B=B)
-        split_fn = _ext_split_fn(B, p.reg_lambda, p.gamma,
-                                 p.min_child_weight, p.reg_alpha)
-        upd_preds = partial(_ext_upd_preds, n_leaf=n_leaf)
-        leaf_calc = partial(_ext_leaf_calc, lam=p.reg_lambda,
-                            eta=p.learning_rate, alpha=p.reg_alpha)
-        pack_tree = partial(_ext_pack_tree, half=half)
-        eval_loss = partial(_ext_eval_loss, obj=obj)
-
-        def grow_one_tree(col, feat_mask, g_d, h_d):
-            """One level-wise tree; returns device (feats, thrs, gains,
-            leaf) and the per-chunk leaf assignments — nothing fetched.
-            Each level consumes every chunk's bins exactly once
-            (advance-from-previous-split fused with the histogram build;
-            the deepest advance fused with the leaf sums), so a streamed
-            chunk pays depth+1 uploads per tree."""
-            node = [zeros_node for _ in range(n_chunks)]
-            feats, thrs, gains = [], [], []
-            prev_hist = None
-            feat = thr = None
-            for level in range(depth):
-                hist = None
-                for c in range(n_chunks):
-                    node[c], ph = adv_hist_lvl(
-                        chunk_bins(c), node[c], g_d[c], h_d[c],
-                        feat, thr, level, col)
-                    hist = ph if hist is None else hist + ph
-                if distributed:
-                    hist = coll.allreduce_device(hist)
-                if level > 0:
-                    hist = sib_stack(hist, prev_hist, level=level)
-                prev_hist = hist
-                feat, thr, gain = split_fn(hist, feat_mask)
-                feats.append(feat)
-                thrs.append(thr)
-                gains.append(gain)
-            gsum = hsum = None
-            for c in range(n_chunks):
-                g_c = g_d[c] if col is None else g_d[c][:, col]
-                h_c = h_d[c] if col is None else h_d[c][:, col]
-                node[c], gs, hs = final_adv_leaf(
-                    chunk_bins(c), node[c], g_c, h_c, feat, thr)
-                gsum = gs if gsum is None else gsum + gs
-                hsum = hs if hsum is None else hsum + hs
-            if distributed:
-                gsum = coll.allreduce_device(gsum)
-                hsum = coll.allreduce_device(hsum)
-            return feats, thrs, gains, leaf_calc(gsum, hsum), node
-
-        def unpack_tree(flat):
-            fl = np.asarray(flat)           # the ONE per-tree host sync
-            d = depth * half
-            feats = fl[:d].astype(np.int32).reshape(depth, half)
-            thrs = fl[d:2 * d].astype(np.int32).reshape(depth, half)
-            gains = fl[2 * d:3 * d].reshape(depth, half)
-            leaf = fl[3 * d:]
-            return feats, thrs, gains, leaf
-
-        def one_round(r, record):
-            """One boosting round; ``record=False`` discards the result
-            (warmup: compiles gh/hist/split/advance/leaf/pack programs
-            and leaves preds/trees untouched)."""
-            feat_mask = None                 # same RNG as the r3 page loop
-            if p.colsample_bytree < 1.0:
-                crng = np.random.default_rng([p.seed, r, 1])
-                n_keep = max(1, int(np.ceil(p.colsample_bytree * F)))
-                scores = crng.random(F)
-                feat_mask = jnp.asarray(
-                    scores <= np.sort(scores)[n_keep - 1])
-            if p.subsample < 1.0:
-                rrng = np.random.default_rng([p.seed, r, 2, coll.rank()])
-                keep = np.zeros((n_chunks, Rc), np.float32)
-                kpos = 0
-                for pr in page_rows:         # per page, in stream order
-                    draws = (rrng.random(pr) < p.subsample).astype(
-                        np.float32)
-                    done = 0
-                    while done < pr:
-                        c, off = divmod(kpos, Rc)
-                        take = min(pr - done, Rc - off)
-                        keep[c, off:off + take] = draws[done:done + take]
-                        done += take
-                        kpos += take
-                wk = [jnp.asarray(w_h[c] * keep[c])
-                      for c in range(n_chunks)]
-            else:
-                wk = w_d
-            g_d, h_d = [], []
-            for c in range(n_chunks):
-                g, h = gh_fn(preds_d[c], y_d[c], wk[c])
-                g_d.append(g)
-                h_d.append(h)
-            if K_cls == 1:
-                feats, thrs, gains, leaf, node = grow_one_tree(
-                    None, feat_mask, g_d, h_d)
-                if not record:
-                    unpack_tree(pack_tree(feats, thrs, gains, leaf))
-                    return
-                for c in range(n_chunks):
-                    preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
-                                           col=None)
-                f, t, gn, lf = unpack_tree(pack_tree(feats, thrs, gains,
-                                                     leaf))
-                self.trees.append({"feat": f, "thr": t, "gain": gn,
-                                   "leaf": lf})
-            else:
-                per_class = []
-                for col in range(K_cls):
-                    feats, thrs, gains, leaf, node = grow_one_tree(
-                        col, feat_mask, g_d, h_d)
-                    if not record:
-                        unpack_tree(pack_tree(feats, thrs, gains, leaf))
-                        continue
-                    for c in range(n_chunks):
-                        preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
-                                               col=col)
-                    per_class.append(unpack_tree(
-                        pack_tree(feats, thrs, gains, leaf)))
-                if not record:
-                    return
-                self.trees.append({
-                    "feat": np.stack([t[0] for t in per_class]),
-                    "thr": np.stack([t[1] for t in per_class]),
-                    "gain": np.stack([t[2] for t in per_class]),
-                    "leaf": np.stack([t[3] for t in per_class]),
-                })
-
-        t_w = get_time()
-        if warmup_rounds > 0:
-            # ONE discarded round compiles every per-level program (the
-            # full set is ~2·depth+5 jits — minutes of remote compile
-            # through a tunnel if left inside the timed region)
-            one_round(0, record=False)
-        warmup_s = get_time() - t_w
-
-        t0 = get_time()
-        for r in range(p.n_trees):
-            one_round(r, record=True)
-            if eval_every and (r + 1) % eval_every == 0:
-                # mean of per-row losses across all chunks (pad rows
-                # excluded by the static n_valid slice), then the
-                # objective's finalizer — a chunk-wise mean of metrics
-                # would be wrong for non-additive metrics
-                num = sum(float(eval_loss(preds_d[c], y_d[c],
-                                          nv=n_valid[c]))
-                          for c in range(n_chunks) if n_valid[c])
-                loss = obj.finalize_mean_loss(num / max(N, 1))
-                LOG("INFO", "round %d: loss=%.5f", r + 1, loss)
-        self.last_fit_seconds = get_time() - t0
-        # the chunk loop has no dispatch-chunk evidence; stale numbers
-        # from an earlier in-core fit must not describe this run
-        self.last_chunk_times = []
-        self.last_warmup_seconds = warmup_s if warmup_rounds > 0 else None
-        # margins live padded per chunk, not as one train-order vector
-        self._train_preds = None
-        self._n_real_rows = None
-        return self
-
     # ------------------------------------------------------------------
     def _round_fn_cache_key(self, n_features: int, n_rounds: int):
         """Everything baked into the traced round program as a constant.

@@ -291,13 +291,21 @@ class GBTRegressor(_SkReg, _EstimatorBase):
 
 
 class GBTRanker(_EstimatorBase):
-    """Learning-to-rank (XGBRanker analog, rank:pairwise over qid)."""
+    """Learning-to-rank (XGBRanker analog) over qid groups.
+
+    ``objective`` passes through like XGBRanker's: ``rank:pairwise``
+    (default, RankNet), or the LambdaMART pair ``rank:ndcg`` /
+    ``rank:map`` (lambdas weighted by |Δndcg| / |Δmap| of swapping the
+    pair in the current ranking)."""
 
     def fit(self, X: np.ndarray, y: np.ndarray, *,
             qid: np.ndarray, **fit_kw: Any) -> "GBTRanker":
         CHECK(self.booster == "gbtree",
-              "rank:pairwise needs the tree booster")
-        self._model = self._make("rank:pairwise")
+              "rank objectives need the tree booster")
+        obj = self._extra.get("objective", "rank:pairwise")
+        CHECK(obj.startswith("rank:"),
+              f"GBTRanker objective must be rank:*, got {obj!r}")
+        self._model = self._make(obj)
         self._model.fit(X, np.asarray(y, np.float32), qid=qid, **fit_kw)
         return self
 

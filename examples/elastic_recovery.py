@@ -1,0 +1,282 @@
+"""Elastic recovery drill: kill a worker mid-fit, relaunch, resume, match.
+
+The reference stack's distinctive distributed capability is the
+*composition* of three mechanisms (SURVEY.md §5 failure-recovery):
+
+* the tracker notices a dead worker and frees its rank
+  (``tracker.py :: RabitTracker`` liveness),
+* the cluster manager relaunches the attempt with a bumped
+  ``DMLC_NUM_ATTEMPT`` (the YARN ApplicationMaster's restart counting),
+* the restarted worker reclaims its rank (``cmd=recover``) and reloads
+  model state, so training continues instead of starting over.
+
+This drill proves the composition end to end on real processes:
+
+1. an "application master" loop launches 2 workers through the DMLC env
+   ABI; a :class:`RabitTracker` runs for the whole job (all attempts);
+2. each worker trains HistGBT over the process-spanning mesh in
+   SEGMENTS (a continued fit per segment), checkpointing to a URI after
+   every segment (rank 0 writes, atomic meta rename, barrier);
+3. on attempt 0, worker 1 SIGKILLs itself MID-FIT — between dispatch
+   chunks inside segment ``DRILL_KILL_SEG``'s boosting loop, after the
+   segment checkpoint machinery has already persisted earlier segments;
+4. the AM reaps the -9, gang-kills the survivor (the YARN abort-kill
+   semantics), bumps ``DMLC_NUM_ATTEMPT``, and relaunches; the tracker
+   has marked both ranks dead and hands them back via ``recover``;
+5. attempt 1 resumes from the last durable checkpoint and finishes;
+6. the final model must match an UNINTERRUPTED run tree-for-tree.
+
+Run it standalone:
+
+    python examples/elastic_recovery.py
+
+(The file is its own worker: the AM launches ``python <this file>
+--worker`` per rank.  ``tests/test_parallel.py`` drives the same
+``run_drill`` in the slow lane.)
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEGS = 4            # checkpoint segments
+SEG_TREES = 4       # boosting rounds per segment
+KILL_SEG = 2        # worker 1 dies inside this segment's fit (attempt 0)
+N_BINS = 32
+KW = dict(max_depth=3, n_bins=N_BINS, learning_rate=0.5, n_trees=SEG_TREES)
+
+
+def make_data():
+    import numpy as np
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.3 * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+def worker_main():
+    from dmlc_core_tpu.utils import force_cpu_devices
+    force_cpu_devices(1)
+    import numpy as np
+    from dmlc_core_tpu.parallel import collectives as coll
+    from dmlc_core_tpu.tracker.tracker import WorkerSession
+
+    task = int(os.environ["DMLC_TASK_ID"])
+    attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+    ckdir = os.environ["DRILL_CKPT_DIR"]
+    kill_seg = int(os.environ.get("DRILL_KILL_SEG", "-1"))
+    uri = os.environ["DMLC_TRACKER_URI"]
+    legacy_port = int(os.environ["DMLC_LEGACY_TRACKER_PORT"])
+
+    # host-level tracker session: fresh rank on attempt 0, RECLAIM the
+    # freed rank on a restart (the rabit recover path)
+    if attempt == 0:
+        ws = WorkerSession(uri, legacy_port, host=f"host{task}")
+    else:
+        ws = WorkerSession(uri, legacy_port, cmd="recover", rank=task)
+        assert ws.info["rank"] == task, ws.info
+
+    coll.init()
+    import jax
+    from jax.sharding import Mesh
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.ops.quantile import compute_cuts
+
+    class KillableGBT(HistGBT):
+        """SIGKILL between dispatch chunks of one fit() — a genuine
+        mid-fit crash (trees of the current segment already partially
+        fetched, segment checkpoint not yet written)."""
+        kill_at_chunk = -1
+
+        def _boost_binned(self, *a, **kw):
+            seen = {"n": 0}
+
+            def cb(rounds_fetched, elapsed_s):
+                seen["n"] += 1
+                if seen["n"] == self.kill_at_chunk:
+                    ws.print_msg(f"worker {task}: SIGKILL mid-fit "
+                                 f"(chunk {seen['n']})")
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+            kw["chunk_callback"] = cb
+            return super()._boost_binned(*a, **kw)
+
+    X, y = make_data()
+    cuts = compute_cuts(X, N_BINS)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    meta_path = os.path.join(ckdir, "meta.json")
+    start_seg = 0
+    model = None
+    if os.path.exists(meta_path):
+        meta = json.load(open(meta_path))
+        start_seg = meta["segments_done"]
+        model = HistGBT.load_model(
+            os.path.join(ckdir, f"seg{start_seg}.bin"), mesh=mesh)
+        model.param.init({"n_trees": SEG_TREES})
+        ws.print_msg(f"worker {task}: resumed at segment {start_seg} "
+                     f"({len(model.trees)} trees)")
+
+    for seg in range(start_seg, SEGS):
+        if model is None:
+            model = KillableGBT(mesh=mesh, **KW)
+            if attempt == 0 and task == 1 and seg == kill_seg:
+                model.kill_at_chunk = 2
+            model.fit(X, y, cuts=cuts)
+        else:
+            if isinstance(model, KillableGBT):
+                model.kill_at_chunk = (
+                    2 if attempt == 0 and task == 1 and seg == kill_seg
+                    else -1)
+            model.fit(X, y)                  # continued fit, cuts kept
+        if coll.rank() == 0:
+            model.save_model(os.path.join(ckdir, f"seg{seg + 1}.bin"))
+            tmp = meta_path + ".tmp"
+            json.dump({"segments_done": seg + 1}, open(tmp, "w"))
+            os.replace(tmp, meta_path)       # atomic: no torn meta
+        coll.barrier()                       # checkpoint durable for all
+        ws.print_msg(f"worker {task}: segment {seg + 1}/{SEGS} done")
+
+    if coll.rank() == 0:
+        model.save_model(os.path.join(ckdir, "final.bin"))
+    coll.barrier()
+    ws.shutdown()
+    coll.finalize()
+
+
+# ---------------------------------------------------------------------------
+# application-master side
+# ---------------------------------------------------------------------------
+
+def run_drill(ckdir, kill=True, max_attempts=3, timeout=600):
+    """Run the full drill; returns a report dict.
+
+    ``kill=False`` runs the same gang/segments with no crash (the
+    uninterrupted comparator can also be produced in-process; see
+    ``reference_fit``).
+    """
+    from dmlc_core_tpu.tracker.tracker import RabitTracker, _free_port
+
+    os.makedirs(ckdir, exist_ok=True)
+    tracker = RabitTracker(host_ip="127.0.0.1", nworker=2)
+    tracker.start()
+    report = {"attempts": [], "dead_seen": [], "recovered": False}
+    try:
+        for attempt in range(max_attempts):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_NUM_WORKER": "2",
+                "DMLC_NUM_SERVER": "0",
+                "DMLC_TRACKER_URI": "127.0.0.1",
+                # fresh jax.distributed coordinator port per attempt (the
+                # previous attempt's coordinator died with worker 0)
+                "DMLC_TRACKER_PORT": str(_free_port("127.0.0.1")),
+                "DMLC_LEGACY_TRACKER_PORT": str(tracker.port),
+                "DMLC_NUM_ATTEMPT": str(attempt),
+                "DMLC_ROLE": "worker",
+                "DRILL_CKPT_DIR": ckdir,
+                "DRILL_KILL_SEG": str(KILL_SEG if kill else -1),
+                "PYTHONPATH": REPO,
+                # several dispatch chunks per segment so "mid-fit"
+                # (between chunks) is a real interior point
+                "DMLC_TPU_ROUNDS_PER_DISPATCH": "2",
+            })
+            procs = []
+            for task in range(2):
+                e = dict(env)
+                e["DMLC_TASK_ID"] = str(task)
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--worker"],
+                    env=e))
+            deadline = time.monotonic() + timeout
+            codes = [None, None]
+            failed = False
+            while any(c is None for c in codes):
+                if time.monotonic() > deadline:
+                    for p in procs:
+                        p.kill()
+                    raise TimeoutError("drill attempt timed out")
+                for i, p in enumerate(procs):
+                    if codes[i] is None and p.poll() is not None:
+                        codes[i] = p.returncode
+                        if p.returncode != 0 and not failed:
+                            failed = True
+                            # YARN AM semantics: one container down →
+                            # abort-kill the gang, count the attempt
+                            for q in procs:
+                                if q.poll() is None:
+                                    q.kill()
+                time.sleep(0.05)
+            report["attempts"].append({"attempt": attempt, "codes": codes})
+            if not failed:
+                report["recovered"] = attempt > 0
+                break
+            # liveness: the tracker must have noticed the deaths and
+            # freed the ranks before the relaunch reclaims them
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 10:
+                if len(tracker.dead_workers) >= 2:
+                    break
+                time.sleep(0.05)
+            report["dead_seen"] = sorted(set(tracker.dead_workers))
+        else:
+            raise RuntimeError(f"drill failed all {max_attempts} attempts: "
+                               f"{report}")
+    finally:
+        tracker.stop()
+    report["final_model"] = os.path.join(ckdir, "final.bin")
+    return report
+
+
+def main():
+    import tempfile
+
+    import numpy as np
+
+    from dmlc_core_tpu.utils import force_cpu_devices
+    force_cpu_devices(1)
+
+    with tempfile.TemporaryDirectory() as killed_dir, \
+            tempfile.TemporaryDirectory() as clean_dir:
+        report = run_drill(killed_dir, kill=True)
+        print(f"attempts: {report['attempts']}")
+        print(f"tracker saw dead ranks: {report['dead_seen']}")
+        assert report["recovered"], "expected a restart to happen"
+
+        # the comparator: the SAME 2-process job, never killed.  (The
+        # crash must be invisible in the result — every segment replays
+        # through the same continued-fit path either way, so parity is
+        # tree-for-tree exact.  A 1-process fit is NOT the comparator:
+        # psum rounding can flip near-tie splits in later trees.)
+        clean = run_drill(clean_dir, kill=False)
+        assert clean["attempts"] == [{"attempt": 0, "codes": [0, 0]}], clean
+
+        from dmlc_core_tpu.models import HistGBT
+        recovered = HistGBT.load_model(report["final_model"])
+        ref = HistGBT.load_model(clean["final_model"])
+        assert len(recovered.trees) == len(ref.trees) == SEGS * SEG_TREES
+        for i, (tr, tf) in enumerate(zip(recovered.trees, ref.trees)):
+            assert np.array_equal(tr["feat"], tf["feat"]), i
+            assert np.array_equal(tr["thr"], tf["thr"]), i
+            np.testing.assert_array_equal(tr["leaf"], tf["leaf"])
+        X, y = make_data()
+        np.testing.assert_array_equal(recovered.predict(X), ref.predict(X))
+        acc = ((recovered.predict(X) > 0.5) == y).mean()
+        print(f"recovered model == uninterrupted model, bit-exact "
+              f"({len(ref.trees)} trees, train acc {acc:.3f})")
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker_main()
+    else:
+        main()

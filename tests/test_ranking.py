@@ -132,3 +132,148 @@ class TestPairwiseRankObjective:
             HistGBT(objective="rank:pairwise").fit(X, y)
         with pytest.raises(Error, match="only valid for rank"):
             HistGBT().fit(X, y, qid=np.zeros(4, np.int64))
+
+
+def _brute_delta(scores, rel, kind):
+    """|Δmetric| of swapping each doc pair's positions in the ranking
+    induced by ``scores`` (desc, stable) — the oracle for the vectorized
+    ``_pair_weight`` closed forms."""
+    G = len(scores)
+    order = np.argsort(-scores, kind="stable")
+
+    def metric(ord_):
+        r = rel[ord_]
+        if kind == "ndcg":
+            disc = 1.0 / np.log2(np.arange(2, G + 2))
+            dcg = ((2.0 ** r - 1.0) * disc).sum()
+            ideal = np.sort(rel)[::-1]
+            idcg = ((2.0 ** ideal - 1.0) * disc).sum()
+            return dcg / idcg if idcg > 0 else 0.0
+        b = (r > 0).astype(np.float64)
+        R = b.sum()
+        if R == 0:
+            return 0.0
+        prec = np.cumsum(b) / np.arange(1, G + 1)
+        return (prec * b).sum() / R
+
+    base = metric(order)
+    pos_of = np.argsort(order)               # rank of each doc
+    out = np.zeros((G, G))
+    for i in range(G):
+        for j in range(G):
+            if i == j:
+                continue
+            o = order.copy()
+            o[pos_of[i]], o[pos_of[j]] = o[pos_of[j]], o[pos_of[i]]
+            out[i, j] = abs(metric(o) - base)
+    return out
+
+
+class TestLambdaWeights:
+    """The LambdaMART pair weights must equal brute-force
+    swap-and-rescore |Δmetric| — the closed forms have enough index
+    algebra (rank gathers, prefix sums, a/b selection) to deserve an
+    oracle."""
+
+    @pytest.mark.parametrize("kind", ["ndcg", "map"])
+    def test_matches_brute_force(self, kind):
+        import jax.numpy as jnp
+        from dmlc_core_tpu.models.gbt_objectives import (_MAPRank,
+                                                         _NDCGRank)
+        rng = np.random.default_rng(11)
+        G = 9
+        obj = (_NDCGRank if kind == "ndcg" else _MAPRank)(G)
+        for trial in range(5):
+            scores = rng.normal(size=G).astype(np.float32)
+            rel = rng.integers(0, 4, size=G).astype(np.float32)
+            if kind == "map":
+                rel = (rel > 1).astype(np.float32)
+            sb = jnp.asarray(scores[None])
+            rb = jnp.asarray(rel[None])
+            better = (rb[:, :, None] > rb[:, None, :])
+            w = np.asarray(obj._pair_weight(sb, rb, better))[0]
+            brute = _brute_delta(scores, rel.astype(np.float64), kind)
+            np.testing.assert_allclose(w, brute, rtol=2e-4, atol=1e-6)
+
+    def test_pads_carry_zero_weight(self):
+        import jax.numpy as jnp
+        from dmlc_core_tpu.models.gbt_objectives import _NDCGRank
+        # two pad docs (rel −1): weights involving them must be 0 and
+        # the real docs' weights must equal the pad-free computation at
+        # the same rank positions (pads rank last via the +inf key)
+        scores = np.array([0.3, -1.2, 2.0, 0.9, -0.5], np.float32)
+        rel = np.array([2.0, 0.0, 1.0, -1.0, -1.0], np.float32)
+        sb, rb = jnp.asarray(scores[None]), jnp.asarray(rel[None])
+        vb = rb >= 0
+        better = ((rb[:, :, None] > rb[:, None, :])
+                  & vb[:, :, None] & vb[:, None, :])
+        w = np.asarray(_NDCGRank(5)._pair_weight(sb, rb, better))[0]
+        w = w * np.asarray(better[0])        # weights are consumed masked
+        assert (w[3:, :] == 0).all() and (w[:, 3:] == 0).all()
+        sb3, rb3 = jnp.asarray(scores[None, :3]), jnp.asarray(rel[None, :3])
+        b3 = (rb3[:, :, None] > rb3[:, None, :])
+        w3 = np.asarray(_NDCGRank(3)._pair_weight(sb3, rb3, b3))[0]
+        np.testing.assert_allclose(w[:3, :3], w3 * np.asarray(b3[0]),
+                                   rtol=1e-6)
+
+
+def _graded_ltr_problem(n_queries=128, docs=30, F=6, seed=0):
+    """Head doc (rel 3) identified by a clean feature; rel-1 labels on
+    half the tail assigned with NO feature signal.  The tail's ~200
+    unlearnable pairs per query dominate RankNet's uniform gradient and
+    pull capacity into noise; |ΔNDCG| weighting concentrates on the
+    learnable head pairs.  Measured margin (held-out ndcg@10, 40 trees):
+    +0.05 to +0.09 across seeds."""
+    rng = np.random.default_rng(seed)
+    Xs, ys, qids = [], [], []
+    for q in range(n_queries):
+        X = rng.normal(size=(docs, F)).astype(np.float32)
+        rel = np.zeros(docs, np.float32)
+        head = int(np.argmax(X[:, 0]))
+        rel[head] = 3.0
+        tail = [i for i in range(docs) if i != head]
+        rel[rng.permutation(tail)[: (docs - 1) // 2]] = 1.0
+        Xs.append(X)
+        ys.append(rel)
+        qids.append(np.full(docs, q, np.int64))
+    return (np.concatenate(Xs), np.concatenate(ys),
+            np.concatenate(qids))
+
+
+class TestLambdaMARTObjectives:
+    def test_ndcg_and_map_learn(self):
+        X, y, qid = _ltr_problem(n_queries=32, seed=2)
+        for objective in ("rank:ndcg", "rank:map"):
+            m = HistGBT(n_trees=15, max_depth=3, n_bins=32,
+                        objective=objective, learning_rate=0.3)
+            m.fit(X, y, qid=qid)
+            nd = ndcg(y, m.predict(X), qid, k=5)
+            assert nd > 0.8, (objective, nd)
+
+    @pytest.mark.slow
+    def test_ndcg_beats_pairwise_on_held_out_ndcg10(self):
+        Xtr, ytr, qtr = _graded_ltr_problem(seed=0)
+        Xte, yte, qte = _graded_ltr_problem(n_queries=64, seed=1)
+        kw = dict(n_trees=40, max_depth=3, n_bins=32, learning_rate=0.3)
+        m_nd = HistGBT(objective="rank:ndcg", **kw)
+        m_nd.fit(Xtr, ytr, qid=qtr)
+        m_pw = HistGBT(objective="rank:pairwise", **kw)
+        m_pw.fit(Xtr, ytr, qid=qtr)
+        nd_nd = ndcg(yte, m_nd.predict(Xte), qte, k=10)
+        nd_pw = ndcg(yte, m_pw.predict(Xte), qte, k=10)
+        # measured: 0.739 vs 0.650 at these seeds; margin +0.05..+0.09
+        # across other seed pairs
+        assert nd_nd > nd_pw + 0.02, (nd_nd, nd_pw)
+        assert nd_nd > 0.7, nd_nd
+
+    def test_gbtranker_objective_passthrough(self):
+        from dmlc_core_tpu.models.sklearn import GBTRanker
+        X, y, qid = _ltr_problem(n_queries=16, seed=4)
+        r = GBTRanker(n_estimators=8, max_depth=2, n_bins=16,
+                      objective="rank:ndcg")
+        r.fit(X, y, qid=qid)
+        assert r.model.param.objective == "rank:ndcg"
+        assert r.score(X, y, qid=qid, k=5) > 0.6
+        from dmlc_core_tpu.base.logging import Error
+        with pytest.raises(Error, match="rank"):
+            GBTRanker(objective="binary:logistic").fit(X, y, qid=qid)
