@@ -6,6 +6,9 @@ and carrying the benchmarks:
 
 * :mod:`histgbt` — XGBoost-style hist gradient-boosted trees, data-parallel
   over the mesh with psum histogram sync (BASELINE configs 1/3 flagship).
+* :mod:`histgbt_sparse` — sparsity-aware boosting for high-dimensional
+  sparse data (F ≈ 10⁴–10⁶, density < 1%): ragged per-feature bins over
+  present entries, O(nnz) histograms, absent ≡ missing.
 * :mod:`resnet` — image trainer fed by the RecordIO infeed pipeline
   (BASELINE config 2).
 * :mod:`bert` — transformer encoder trained with KVStore-shaped gradient
@@ -18,6 +21,7 @@ and carrying the benchmarks:
 """
 
 from dmlc_core_tpu.models.histgbt import HistGBT, HistGBTParam  # noqa: F401
+from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT  # noqa: F401
 from dmlc_core_tpu.models.resnet import ResNet, ResNetParam, ResNetTrainer  # noqa: F401
 from dmlc_core_tpu.models.bert import BERT, BERTParam  # noqa: F401
 from dmlc_core_tpu.models.fm import FM, FMParam  # noqa: F401

@@ -1,0 +1,78 @@
+"""High-dimensional sparse boosting: LibSVM → CSR → SparseHistGBT.
+
+The workload the LibSVM format exists for — bag-of-words / hashed
+one-hot features (F ≈ 10⁴–10⁶, density < 1%) — where a dense ``[n, F]``
+bin matrix is impossible and absent entries carry meaning (XGBoost's
+sparsity-aware missing semantics).  The sparse engine bins PRESENT
+values into ragged per-feature cuts, builds O(nnz) histograms, and
+learns a default direction per node for the absent mass.
+
+Run: python examples/sparse_highdim_gbt.py
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_core_tpu.data import RowBlockIter
+from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+
+
+def main():
+    tmp = tempfile.mkdtemp()
+    svm = os.path.join(tmp, "train.svm")
+    rng = np.random.default_rng(0)
+    n, F, per_row = 8_000, 50_000, 30
+    # power-law feature popularity; features 0/1 carry the label
+    pop = 1.0 / np.arange(1, F + 1) ** 0.7
+    pop /= pop.sum()
+    rows = []
+    y = np.empty(n, np.int32)
+    for i in range(n):
+        cols = np.unique(np.concatenate(
+            [[0, 1], rng.choice(F, size=per_row, p=pop)]))
+        vals = rng.normal(size=len(cols)).astype(np.float32)
+        v0 = vals[cols == 0][0]
+        v1 = vals[cols == 1][0]
+        y[i] = int(v0 + 0.5 * v1 > 0)
+        rows.append((cols, vals))
+    with open(svm, "w") as f:
+        for i, (cols, vals) in enumerate(rows):
+            feats = " ".join(f"{c}:{v:.4f}" for c, v in zip(cols, vals))
+            f.write(f"{y[i]} {feats}\n")
+
+    # parse through the data plane, then hand the CSR arrays straight to
+    # the sparse engine (one block here; concatenate for paged inputs)
+    blocks = list(RowBlockIter.create(svm, 0, 1, "libsvm"))
+    offset = np.concatenate(
+        [[0]] + [np.diff(b.offset) for b in blocks]).cumsum()
+    index = np.concatenate([b.index for b in blocks])
+    value = np.concatenate(
+        [b.value if b.value is not None else np.ones(len(b.index),
+                                                     np.float32)
+         for b in blocks])
+    label = np.concatenate([b.label for b in blocks])
+
+    model = SparseHistGBT(n_trees=20, max_depth=4, n_bins=32,
+                          learning_rate=0.4)
+    model.fit(offset, index, value, label, n_features=F)
+    pred = model.predict(offset, index, value)
+    acc = ((pred > 0.5) == label).mean()
+    print(f"F={F}: {model.cuts.total_bins} ragged bins "
+          f"(dense would need {F * 32}), train acc {acc:.3f}")
+    assert acc > 0.9
+
+    uri = os.path.join(tmp, "sparse_model.bin")
+    model.save_model(uri)
+    again = SparseHistGBT.load_model(uri)
+    np.testing.assert_array_equal(
+        again.predict(offset, index, value, output_margin=True),
+        model.predict(offset, index, value, output_margin=True))
+    print("save/load round trip OK")
+
+
+if __name__ == "__main__":
+    main()

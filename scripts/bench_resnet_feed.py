@@ -1,0 +1,138 @@
+"""Config-2 at its written scale: 224x224x3 records -> ResNet-50 feed.
+
+BASELINE config 2 names an ImageNet-shard-scale pipeline (sharded
+RecordIO -> DeviceFeed -> a ResNet-50-class consumer at batch 256);
+round 4 proved the machinery at 32x32/ResNet-18 scale.  This bench runs
+the REAL shape and — because a remotely-tunneled chip cannot absorb
+38 MB/batch (tunnel H2D is 5-17 MB/s; a local PCIe/direct attachment
+moves GB/s) — it decomposes the claim into independently measured
+parts, each tagged with its basis:
+
+1. ``host_pipeline_records_per_sec`` — the data plane alone (sharded
+   RecordIO read -> record unpack -> batch assembly) at 224^3.  This is
+   the part config 2 actually claims (the feed is never the
+   bottleneck); it is tunnel-independent.
+2. ``device_step_seconds`` / ``device_records_per_sec`` — the
+   ResNet-50 train step at batch 256 on resident data (device-bound
+   ceiling; FLOP-checked against the 3.1 TFLOP/step estimate).
+3. ``h2d_mbps`` — the measured tunnel transfer rate for one batch.
+4. ``e2e_*`` — the honest end-to-end run through DeviceFeed with its
+   stall fraction, which on a TUNNEL is transfer-bound by (3), not by
+   (1): the stall verdict for local attachment is
+   ``host_pipeline >= device rate``, emitted as ``feed_keeps_up``.
+
+Env knobs: RESNET_RECORDS (1536), RESNET_BATCH (256), RESNET_STEPS (8),
+RESNET_HW (224), RESNET_VARIANT (resnet50), BENCH_CPU=1.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("BENCH_CPU"):
+    from dmlc_core_tpu.utils import force_cpu_devices
+    force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+
+def write_shards(root, n_records, hw, n_shards=4):
+    from dmlc_core_tpu.data.image_record import pack_image_record
+    from dmlc_core_tpu.io.recordio import RecordIOWriter
+
+    rng = np.random.default_rng(0)
+    per = n_records // n_shards
+    for s in range(n_shards):
+        with RecordIOWriter(os.path.join(root, f"part-{s}.rec")) as w:
+            for _ in range(per):
+                label = int(rng.integers(0, 1000))
+                img = rng.integers(0, 256, size=(hw, hw, 3),
+                                   dtype=np.uint8)
+                img[..., 0] = (img[..., 0] // 4
+                               + (label % 10) * 25).astype(np.uint8)
+                w.write_record(pack_image_record(img, label))
+    return per * n_shards
+
+
+def main():
+    n_records = int(os.environ.get("RESNET_RECORDS", 1536))
+    batch = int(os.environ.get("RESNET_BATCH", 256))
+    steps = int(os.environ.get("RESNET_STEPS", 8))
+    hw = int(os.environ.get("RESNET_HW", 224))
+    variant = os.environ.get("RESNET_VARIANT", "resnet50")
+
+    import jax
+
+    from dmlc_core_tpu.data.image_record import batch_iterator
+    from dmlc_core_tpu.models.resnet import ResNetTrainer
+
+    root = tempfile.mkdtemp(prefix="resnet_feed_")
+    t0 = time.perf_counter()
+    total = write_shards(root, n_records, hw)
+    write_s = time.perf_counter() - t0
+    uri = os.path.join(root, "part-*.rec")
+
+    # 1. host pipeline alone (the config-2 claim's own leg)
+    t0 = time.perf_counter()
+    host_recs = 0
+    for images, labels in batch_iterator(uri, 0, 1, batch, (hw, hw, 3)):
+        host_recs += len(labels)
+    host_s = time.perf_counter() - t0
+    host_rate = host_recs / host_s
+
+    # 2. device step on resident data (the consumption ceiling)
+    trainer = ResNetTrainer(variant=variant, num_classes=1000,
+                            learning_rate=0.05)
+    trainer.init((hw, hw, 3))
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, size=(batch, hw, hw, 3), dtype=np.uint8)
+    lbls = rng.integers(0, 1000, size=batch).astype(np.int32)
+    import jax.numpy as jnp
+    di, dl = jnp.asarray(imgs), jnp.asarray(lbls)
+    loss, acc = trainer.train_step(di, dl)          # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, acc = trainer.train_step(di, dl)
+    jax.block_until_ready(loss)
+    step_s = (time.perf_counter() - t0) / steps
+    device_rate = batch / step_s
+    # ResNet-50 fwd ~4.1 GFLOP/img at 224^3; train ~3x
+    tflop_step = 3 * 4.1e9 * batch / 1e12 if hw == 224 else None
+
+    # 3. tunnel/interconnect H2D for one batch
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(jax.device_put(imgs))
+    h2d_mbps = 3 * imgs.nbytes / (time.perf_counter() - t0) / 1e6
+
+    # 4. honest end-to-end through DeviceFeed
+    e2e = trainer.fit_from_records(uri, batch_size=batch,
+                                   image_shape=(hw, hw, 3), epochs=1)
+
+    out = {
+        "metric": "resnet_feed_224",
+        "records": total, "batch": batch, "hw": hw, "variant": variant,
+        "write_seconds": round(write_s, 2),
+        "host_pipeline_records_per_sec": round(host_rate, 1),
+        "host_pipeline_mbps": round(host_rate * hw * hw * 3 / 1e6, 1),
+        "device_step_seconds": round(step_s, 4),
+        "device_records_per_sec": round(device_rate, 1),
+        "est_tflop_per_step": tflop_step,
+        "h2d_mbps": round(h2d_mbps, 1),
+        "e2e_records_per_sec": round(e2e["records_per_sec"], 1),
+        "e2e_stall_fraction": round(e2e["infeed_stall_fraction"], 4),
+        "e2e_basis": "through the remote tunnel the feed is H2D-bound "
+                     "(h2d_mbps vs 38 MB/batch), not host-pipeline-"
+                     "bound; locally attached chips move GB/s",
+        "feed_keeps_up": bool(host_rate >= device_rate),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,18 @@ def _kernel_variant(bins_ref, node_ref, g_ref, h_ref, out_ref, *,
                 attribute construction vs MXU cost
     noconstr  — dot on REUSED one-hots (construction hoisted out of the
                 per-feature loop; wrong results, timing only)
+    pack4/8   — r5, the "bin-packed dot" half of VERDICT r3 #5: S
+                features share ONE dot ([S·2nh, T]·[S·lo, T] → the
+                [2nh, lo] diagonal blocks are the per-feature results,
+                cross-feature off-diagonals discarded).  A lo=32 dot
+                pads 32 → 128 RHS lanes; packing fills those lanes
+                with real work and cuts per-tile dot issues S×.  (The
+                int8-MXU half of r3 #5 is analytically out: the LHS
+                carries f32 g/h scaling — an int8×int8 dot can only
+                COUNT, and the histogram needs weighted sums; also
+                Mosaic rejects sub-int32 vector compares on this
+                target, so int8 one-hot construction has no path
+                either.)
     """
     i = pl.program_id(0)
     node = node_ref[:].astype(jnp.int32)
@@ -90,6 +102,27 @@ def _kernel_variant(bins_ref, node_ref, g_ref, h_ref, out_ref, *,
         blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)
         t0s = t0_node + blk // lo
         los = blk % lo
+        if variant in ("pack4", "pack8"):
+            S = int(variant[4:])
+            for j in range(8 // S):
+                lhss, rhss = [], []
+                for k in range(S):
+                    kk = S * j + k
+                    oh = (nh_iota == t0s[kk:kk + 1]).astype(jnp.bfloat16)
+                    lhss.append(jnp.concatenate([oh * g, oh * h], axis=0))
+                    rhss.append((lo_iota == los[kk:kk + 1])
+                                .astype(jnp.bfloat16))
+                d = jax.lax.dot_general(
+                    jnp.concatenate(lhss, axis=0),
+                    jnp.concatenate(rhss, axis=0),
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [S·2nh, S·lo]
+                acc = jnp.stack(
+                    [d[k * 2 * nh:(k + 1) * 2 * nh,
+                       k * lo:(k + 1) * lo] for k in range(S)], axis=0)
+                idx = (pl.ds(base + S * j, S), slice(None), slice(None))
+                out_ref[idx] = out_ref[idx] + acc
+            return carry
         if variant == "grpacc":
             # ONE [8, 2nh, lo] write per feature group instead of 8
             # sublane-padded [1, ...] read-modify-writes.  jnp.stack of
@@ -193,7 +226,7 @@ def main():
            "platform": jax.devices()[0].platform}
     for n_build in (1, 2):               # the L0-L2 floor levels
         bins_t, node, g, h = _prep(n_build)
-        for variant in ("prod", "shipped", "grpacc"):
+        for variant in ("prod", "shipped", "pack4", "pack8"):
             try:
                 ms = _run_level(bins_t, node, g, h, n_build, variant) * 1e3
                 out[f"nb{n_build}_{variant}_ms"] = round(ms, 3)
