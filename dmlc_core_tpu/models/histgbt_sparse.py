@@ -88,11 +88,12 @@ def _pack_tree(feats, thrs, dirs, gains, leaf, *, half):
 
 
 @partial(jax.jit,
-         static_argnames=("k", "obj", "depth", "total_bins", "lam",
-                          "gamma", "mcw", "alpha", "eta"))
+         static_argnames=("k", "obj", "depth", "total_bins", "n_dense",
+                          "b_max", "lam", "gamma", "mcw", "alpha", "eta"))
 def _sparse_rounds_k(row_e, gb_e, y, w, preds, bin_ptr_d, feat_of_bin_d,
-                     last_mask, *, k: int, obj, depth: int,
-                     total_bins: int, lam: float, gamma: float,
+                     last_mask, dense_pos_d, *, k: int, obj, depth: int,
+                     total_bins: int, n_dense: int, b_max: int,
+                     lam: float, gamma: float,
                      mcw: float, alpha: float, eta: float):
     """``k`` boosting rounds in ONE dispatch (``lax.scan``), returning
     the updated margins and the ``[k, L]`` packed trees — the sparse
@@ -104,8 +105,9 @@ def _sparse_rounds_k(row_e, gb_e, y, w, preds, bin_ptr_d, feat_of_bin_d,
         g, h = obj.grad_hess(preds_c, y)
         flat, node, leaf = _sparse_round_core(
             row_e, gb_e, g * w, h * w, bin_ptr_d, feat_of_bin_d,
-            last_mask, depth=depth, total_bins=total_bins, lam=lam,
-            gamma=gamma, mcw=mcw, alpha=alpha, eta=eta)
+            last_mask, dense_pos_d, depth=depth,
+            total_bins=total_bins, n_dense=n_dense, b_max=b_max,
+            lam=lam, gamma=gamma, mcw=mcw, alpha=alpha, eta=eta)
         return _leaf_update(preds_c, node, leaf), flat
 
     preds, flats = jax.lax.scan(body, preds, None, length=k)
@@ -113,22 +115,25 @@ def _sparse_rounds_k(row_e, gb_e, y, w, preds, bin_ptr_d, feat_of_bin_d,
 
 
 @partial(jax.jit,
-         static_argnames=("depth", "total_bins", "lam", "gamma", "mcw",
-                          "alpha", "eta"))
+         static_argnames=("depth", "total_bins", "n_dense", "b_max",
+                          "lam", "gamma", "mcw", "alpha", "eta"))
 def _sparse_round(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d, last_mask,
-                  *, depth: int, total_bins: int, lam: float,
+                  dense_pos_d, *, depth: int, total_bins: int,
+                  n_dense: int, b_max: int, lam: float,
                   gamma: float, mcw: float, alpha: float, eta: float):
     """ONE dispatch per boosting round: all levels (route → histogram →
     totals → split) unrolled in a single program (the per-round entry
     used when per-round host RNG must interleave, i.e. subsample)."""
     return _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d,
-                              feat_of_bin_d, last_mask, depth=depth,
-                              total_bins=total_bins, lam=lam,
+                              feat_of_bin_d, last_mask, dense_pos_d,
+                              depth=depth, total_bins=total_bins,
+                              n_dense=n_dense, b_max=b_max, lam=lam,
                               gamma=gamma, mcw=mcw, alpha=alpha, eta=eta)
 
 
 def _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d,
-                       last_mask, *, depth: int, total_bins: int,
+                       last_mask, dense_pos_d, *, depth: int,
+                       total_bins: int, n_dense: int, b_max: int,
                        lam: float, gamma: float, mcw: float,
                        alpha: float, eta: float):
     n = g.shape[0]
@@ -155,6 +160,7 @@ def _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d,
         totals = node_totals(node, g, h, n_nodes=n_nodes)
         feat, thr, dirv, gain = sparse_best_split(
             full, totals, bin_ptr_d, feat_of_bin_d, last_mask,
+            dense_pos_d, n_dense=n_dense, b_max=b_max,
             lam=lam, gamma=gamma, mcw=mcw, alpha=alpha)
         feats.append(feat)
         thrs.append(thr)
@@ -169,8 +175,12 @@ def _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d,
 
 
 class SparseHistGBT:
-    """Sparsity-aware boosting over CSR input (``offset/index/value``
-    arrays or a :class:`~dmlc_core_tpu.data.row_block.RowBlock`)."""
+    """Sparsity-aware boosting over CSR input.
+
+    :meth:`fit`/:meth:`predict` take raw ``offset/index/value`` arrays;
+    :meth:`fit_block`/:meth:`predict_block` accept a
+    :class:`~dmlc_core_tpu.data.row_block.RowBlock` directly (the data
+    plane's parser output)."""
 
     _MODEL_MAGIC = b"DCTSGB01"
 
@@ -207,6 +217,10 @@ class SparseHistGBT:
                  else np.ascontiguousarray(value, np.float32))
         CHECK_EQ(len(index), len(value), "index/value length mismatch")
         CHECK_EQ(int(offset[-1]), len(index), "offset[-1] != nnz")
+        CHECK(len(index) == 0 or int(index.min()) >= 0,
+              "negative feature indices — they would wrap through "
+              "numpy indexing into the LAST feature's bins and score "
+              "silently wrong")
         CHECK(np.isfinite(value).all(),
               "sparse values must be finite — absent entries ARE the "
               "missing mass; an explicit NaN would silently bin as the "
@@ -268,6 +282,15 @@ class SparseHistGBT:
         # each feature's LAST bin is not a threshold candidate
         last_mask = jnp.asarray(
             np.isin(np.arange(TB), self.cuts.bin_ptr[1:] - 1))
+        # padded-dense slot per global bin — the split scan's exact
+        # per-feature cumsum layout (see sparse_best_split numerics)
+        widths = np.diff(self.cuts.bin_ptr)
+        b_max = int(widths.max()) if len(widths) else 1
+        dense_pos = (self.cuts.feat_of_bin.astype(np.int64) * b_max
+                     + np.arange(TB)
+                     - self.cuts.bin_ptr[self.cuts.feat_of_bin])
+        dense_pos_d = jnp.asarray(dense_pos)
+        n_dense = F * b_max
         y_d = jnp.asarray(y)
         w_d = (jnp.ones(n, jnp.float32) if weight is None
                else jnp.asarray(np.asarray(weight, np.float32)))
@@ -278,7 +301,8 @@ class SparseHistGBT:
         half = max(n_leaf >> 1, 1)
         d = depth * half
         self.trees = []
-        cfg = dict(depth=depth, total_bins=TB, lam=p.reg_lambda,
+        cfg = dict(depth=depth, total_bins=TB, n_dense=n_dense,
+                   b_max=b_max, lam=p.reg_lambda,
                    gamma=p.gamma, mcw=p.min_child_weight,
                    alpha=p.reg_alpha, eta=p.learning_rate)
 
@@ -304,7 +328,8 @@ class SparseHistGBT:
                 k = min(K, p.n_trees - done)
                 preds, flats = _sparse_rounds_k(
                     row_e, gb_e, y_d, w_d, preds, bin_ptr_d,
-                    feat_of_bin_d, last_mask, k=k, obj=self._obj, **cfg)
+                    feat_of_bin_d, last_mask, dense_pos_d, k=k,
+                    obj=self._obj, **cfg)
                 for flat in np.asarray(flats):
                     unpack(flat)
                 done += k
@@ -316,7 +341,7 @@ class SparseHistGBT:
                 wk = w_d * jnp.asarray(keep)
                 flat_d, node, leaf = _sparse_round(
                     row_e, gb_e, g * wk, h * wk, bin_ptr_d,
-                    feat_of_bin_d, last_mask, **cfg)
+                    feat_of_bin_d, last_mask, dense_pos_d, **cfg)
                 preds = _leaf_update(preds, node, leaf)
                 unpack(np.asarray(flat_d))
         jax.block_until_ready(preds)
@@ -324,7 +349,20 @@ class SparseHistGBT:
         self._train_margin = preds
         return self
 
+    def fit_block(self, block, y=None, weight: Optional[np.ndarray] = None,
+                  n_features: Optional[int] = None) -> "SparseHistGBT":
+        """Train from a :class:`RowBlock` (labels/weights from the block
+        unless overridden)."""
+        return self.fit(block.offset, block.index, block.value,
+                        block.label if y is None else y,
+                        weight=block.weight if weight is None else weight,
+                        n_features=n_features)
+
     # -- inference ------------------------------------------------------
+    def predict_block(self, block, **kw) -> np.ndarray:
+        """Score a :class:`RowBlock` (see :meth:`predict`)."""
+        return self.predict(block.offset, block.index, block.value, **kw)
+
     def predict(self, offset, index, value,
                 output_margin: bool = False,
                 n_trees: Optional[int] = None) -> np.ndarray:

@@ -24,6 +24,16 @@ would be 10–1000 GB.  This module is the sparsity-aware substrate
 Everything here is representation-level (host numpy for the one-time
 cut/bin passes, jitted segment-sums for the per-round work); the tree
 loop lives in ``models/histgbt_sparse.py``.
+
+Measured floor (v5e, 24M nnz, TB=1.6M, fetch-synced — block_until_ready
+is a no-op through the remote tunnel): histogram scatter ~1.1 s/level,
+routing ~1.0 s/level (now ~halved by the single coded scatter), split
+scan 0.3 s, totals negligible.  Dead end, kept so it is not re-derived:
+packing (g, h) into ONE complex64 scatter — ``segment_sum`` over
+complex64 raises ``UNIMPLEMENTED: TPU backend error``; the apparent 2×
+in a slice-synced microbenchmark was dead-code elimination.  The honest
+remaining lever is a Pallas sorted-segment reduction (entries pre-sorted
+by gb are static across rounds), left for a future round.
 """
 
 from __future__ import annotations
@@ -199,37 +209,51 @@ def node_totals(node, g, h, *, n_nodes: int):
         jax.ops.segment_sum(h, safe, num_segments=n_nodes + 1)[:-1]])
 
 
-@partial(jax.jit, static_argnames=("lam", "gamma", "mcw", "alpha"))
+@partial(jax.jit, static_argnames=("n_dense", "b_max", "lam", "gamma",
+                                   "mcw", "alpha"))
 def sparse_best_split(hist, totals, bin_ptr_d, feat_of_bin_d, last_mask,
-                      *, lam: float, gamma: float, mcw: float,
+                      dense_pos_d, *, n_dense: int, b_max: int,
+                      lam: float, gamma: float, mcw: float,
                       alpha: float = 0.0):
     """Sparsity-aware split chooser over the ragged flat bin space.
 
     ``hist`` [2, N, TB] (present-entry g/h per global bin), ``totals``
     [2, N] (ALL rows), ``bin_ptr_d`` [F+1], ``feat_of_bin_d`` [TB],
     ``last_mask`` [TB] (True at each feature's LAST bin — not a valid
-    threshold).  For every candidate bin the absent mass
-    ``totals − feature_present`` is tried on both sides (the learned
-    default direction).  Returns (feat [N], thr_local [N], dir [N]
-    (1 = missing left), gain [N]) with the dense engine's degenerate
+    threshold), ``dense_pos_d`` [TB] (each global bin's slot in the
+    feature-padded ``[F, b_max]`` layout, ``n_dense = F · b_max``).
+    For every candidate bin the absent mass ``totals −
+    feature_present`` is tried on both sides (the learned default
+    direction).  Returns (feat [N], thr_local [N], dir [N] (1 =
+    missing left), gain [N]) with the dense engine's degenerate
     convention: gain ≤ gamma → feat 0 / thr = width(f0)−1 / dir 1
     (everyone, missing included, goes left).
+
+    Numerics: within-feature prefixes are computed by scattering the
+    ragged hist into the padded per-feature layout and cumsumming along
+    the SHORT minor axis — each feature's prefix sees only its OWN
+    mass.  A single global cumsum with start-subtraction (the first
+    formulation) rides the whole dataset's magnitude (f32 ulp ~0.25 at
+    a 10⁶ Hessian prefix), drowning rare features; a segmented
+    associative_scan is exact but measured ~10× slower than cumsum on
+    this backend (bench went 110 s → timeout).  The scatter/gather pair
+    is memory-bound like the cumsum itself.
     """
     g, h = hist[0], hist[1]                              # [N, TB]
     N, TB = g.shape
-    cum_g = jnp.cumsum(g, axis=1)
-    cum_h = jnp.cumsum(h, axis=1)
-    # within-feature inclusive prefix: subtract the cumsum just before
-    # the feature's first bin
-    start = bin_ptr_d[feat_of_bin_d]                     # [TB] seg start
-    ext_g = jnp.concatenate([jnp.zeros((N, 1), g.dtype), cum_g], axis=1)
-    ext_h = jnp.concatenate([jnp.zeros((N, 1), h.dtype), cum_h], axis=1)
-    gl = cum_g - ext_g[:, start]                         # [N, TB]
-    hl = cum_h - ext_h[:, start]
-    # the feature's TOTAL present mass = prefix at its last bin
-    end = bin_ptr_d[feat_of_bin_d + 1]                   # [TB] seg end
-    Tg = ext_g[:, end] - ext_g[:, start]
-    Th = ext_h[:, end] - ext_h[:, start]
+
+    def seg_cumsum(x):
+        dense = jnp.zeros((N, n_dense), x.dtype).at[:, dense_pos_d].set(x)
+        cum = jnp.cumsum(dense.reshape(N, n_dense // b_max, b_max),
+                         axis=2).reshape(N, n_dense)
+        return cum[:, dense_pos_d]
+
+    gl = seg_cumsum(g)                                   # [N, TB]
+    hl = seg_cumsum(h)
+    # the feature's TOTAL present mass = its prefix at its LAST bin
+    end1 = bin_ptr_d[feat_of_bin_d + 1] - 1              # [TB] last bin
+    Tg = gl[:, end1]
+    Th = hl[:, end1]
     gt = totals[0][:, None]                              # [N, 1] all rows
     ht = totals[1][:, None]
     miss_g = gt - Tg                                     # absent mass
@@ -289,7 +313,11 @@ def route_level(row_e, gb_e, node, feat, thr, dirv, bin_ptr_d,
     safe = jnp.where(valid, node, 0)
     # default child: missing direction (dir=1 → left)
     default = 2 * safe + jnp.where(dirv[safe], 0, 1)
-    # entry overrides
+    # entry overrides — ONE integer-coded scatter (a row has at most
+    # one entry of its split feature, so code ∈ {0, 2, 3} after the
+    # sum: bit 1 = "entry present", bit 0 = its right-verdict.  Two
+    # separate segment_sums cost ~2× here; the scatter is the
+    # per-level floor at 10⁷+ nnz — measured 1.0 s → ~0.55 s at 24M).
     n_e = node[row_e]
     ok_e = n_e >= 0
     safe_e = jnp.where(ok_e, n_e, 0)
@@ -297,9 +325,8 @@ def route_level(row_e, gb_e, node, feat, thr, dirv, bin_ptr_d,
     match = ok_e & (feat_of_bin_d[gb_e] == feat[safe_e])
     side = match & (gb_e > split_gb)                     # right verdict
     seg = jnp.where(ok_e, row_e, n)
-    cnt = jax.ops.segment_sum(match.astype(jnp.int32), seg,
-                              num_segments=n + 1)[:-1]
-    sides = jax.ops.segment_sum(side.astype(jnp.int32), seg,
-                                num_segments=n + 1)[:-1]
-    routed = 2 * safe + jnp.where(cnt > 0, sides, default - 2 * safe)
+    code = jax.ops.segment_sum(
+        match.astype(jnp.int32) * 2 + side.astype(jnp.int32), seg,
+        num_segments=n + 1)[:-1]
+    routed = 2 * safe + jnp.where(code >= 2, code & 1, default - 2 * safe)
     return jnp.where(valid, routed, -1)

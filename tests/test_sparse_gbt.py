@@ -335,6 +335,77 @@ class TestSparseModel:
         assert (up.predict(offset, index, value).mean()
                 > base.predict(offset, index, value).mean() + 0.02)
 
+    def test_split_scan_precision_rare_feature_after_heavy_mass(self):
+        # The split scan's per-feature prefixes must NOT ride a global
+        # f32 cumsum: with ~1e7 of g/h mass in earlier bins (f32 ulp
+        # ~1.0 there), a rare later feature's mass (~tens) would drown
+        # in prefix rounding.  The segmented scan keeps per-feature
+        # error bounded by the feature's OWN mass.
+        import jax.numpy as jnp
+        from dmlc_core_tpu.ops.sparse_hist import sparse_best_split
+        F_heavy, B = 2000, 8
+        TB = F_heavy * B + 4                 # + tiny feature (4 bins)
+        rng = np.random.default_rng(0)
+        hist = np.zeros((2, 1, TB), np.float32)
+        hist[:, 0, :F_heavy * B] = rng.random((2, F_heavy * B)) * 1e4
+        tiny = np.array([13.0, 7.0, 29.0, 5.0], np.float32)
+        hist[0, 0, F_heavy * B:] = tiny
+        hist[1, 0, F_heavy * B:] = tiny / 2
+        widths = np.full(F_heavy + 1, B, np.int64)
+        widths[-1] = 4
+        bin_ptr = np.concatenate([[0], np.cumsum(widths)])
+        fob = np.repeat(np.arange(F_heavy + 1, dtype=np.int32), widths)
+        last = np.isin(np.arange(TB), bin_ptr[1:] - 1)
+        totals = np.asarray(hist.sum(axis=2) * 1.5, np.float32)
+        b_max = int(widths.max())
+        dense_pos = (fob.astype(np.int64) * b_max
+                     + np.arange(TB) - bin_ptr[fob])
+        feat, thr, dirv, gain = sparse_best_split(
+            jnp.asarray(hist), jnp.asarray(totals),
+            jnp.asarray(bin_ptr), jnp.asarray(fob), jnp.asarray(last),
+            jnp.asarray(dense_pos), n_dense=(F_heavy + 1) * b_max,
+            b_max=b_max, lam=1.0, gamma=0.0, mcw=1.0)
+        # reconstruct the tiny feature's left-masses from the same code
+        # path via a probe: run the scan on JUST the tiny feature and
+        # compare the chosen gain's inputs indirectly — cheapest honest
+        # probe: the scan must place the tiny feature's cumulative
+        # masses exactly (we recompute the gain for its best threshold
+        # in f64 and check the engine found a gain at least that good
+        # minus a tiny-mass-scale tolerance)
+        g64 = tiny.astype(np.float64)
+        h64 = (tiny / 2).astype(np.float64)
+        gt, ht = float(totals[0, 0]), float(totals[1, 0])
+
+        def gain64(t, miss_left):
+            gl, hl = g64[:t + 1].sum(), h64[:t + 1].sum()
+            if miss_left:
+                gl += gt - g64.sum()
+                hl += ht - h64.sum()
+            gr, hr = gt - gl, ht - hl
+            if hl < 1.0 or hr < 1.0:
+                return -np.inf
+            return gl * gl / (hl + 1) + gr * gr / (hr + 1) \
+                - gt * gt / (ht + 1)
+
+        best_tiny = max(gain64(t, ml) for t in range(3)
+                        for ml in (False, True))
+        assert float(gain[0]) >= best_tiny - 1e-3 * abs(best_tiny)
+
+    def test_block_api_and_negative_index(self):
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.data.row_block import RowBlock
+        offset, index, value, y, _, _ = _sparse_problem(seed=43)
+        blk = RowBlock(offset=offset, label=y, index=index, value=value)
+        m = SparseHistGBT(n_trees=4, max_depth=2, n_bins=16)
+        m.fit_block(blk)
+        np.testing.assert_array_equal(
+            m.predict_block(blk, output_margin=True),
+            m.predict(offset, index, value, output_margin=True))
+        bad = index.copy()
+        bad[5] = -1
+        with pytest.raises(Error, match="negative"):
+            m.predict(offset, bad, value)
+
     def test_subsample_trains(self):
         offset, index, value, y, _, _ = _sparse_problem(seed=41)
         m = SparseHistGBT(n_trees=15, max_depth=3, n_bins=16,
