@@ -19,11 +19,11 @@ XGBoost's sparsity-aware split finding):
 
 Trees store (feat, thr, dir, leaf) per level like the dense missing
 engine; ``thr`` is a LOCAL bin index into the feature's ragged cut
-range.  v1 scope (recorded in PARITY.md): single-device (the sparse
-workloads that motivate it are sample-bound, not FLOP-bound — shard
-rows across workers with the external data plane before reaching for
-in-fit collectives), objectives binary:logistic / reg:squarederror,
-unweighted quantile cuts.
+range.  Distributed data-parallel fits shard rows across workers:
+global cuts via the candidate-matrix allgather-merge and per-level
+histogram/total ``allreduce_device`` (see :meth:`SparseHistGBT.fit`).
+v1 scope (recorded in PARITY.md): objectives binary:logistic /
+reg:squarederror, unweighted quantile cuts.
 """
 
 from __future__ import annotations
@@ -45,8 +45,11 @@ from dmlc_core_tpu.models.gbt_split import _maybe_l1
 from dmlc_core_tpu.models.histgbt import HistGBTParam
 from dmlc_core_tpu.ops.sparse_hist import (SparseCuts, bin_sparse_entries,
                                            build_sparse_cuts, csr_rows,
-                                           level_histogram, node_totals,
-                                           route_level, sparse_best_split)
+                                           level_histogram,
+                                           merge_sparse_cut_candidates,
+                                           node_totals, route_level,
+                                           sparse_best_split,
+                                           sparse_cut_candidates)
 
 __all__ = ["SparseHistGBT"]
 
@@ -135,7 +138,11 @@ def _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d,
                        last_mask, dense_pos_d, *, depth: int,
                        total_bins: int, n_dense: int, b_max: int,
                        lam: float, gamma: float, mcw: float,
-                       alpha: float, eta: float):
+                       alpha: float, eta: float, reduce_fn=None):
+    # reduce_fn: cross-worker sum hook (allreduce_device) applied to
+    # every histogram / node-total — identity single-worker, so the
+    # local and distributed engines share ONE tree-growing core
+    rf = reduce_fn or (lambda x: x)
     n = g.shape[0]
     n_leaf = 1 << depth
     half = max(n_leaf >> 1, 1)
@@ -149,15 +156,16 @@ def _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d,
         if level > 0:
             node = route_level(row_e, gb_e, node, feat, thr, dirv,
                                bin_ptr_d, feat_of_bin_d)
-        left = level_histogram(row_e, gb_e, node, g, h, n_build=n_build,
-                               total_bins=total_bins, level=level)
+        left = rf(level_histogram(row_e, gb_e, node, g, h,
+                                  n_build=n_build,
+                                  total_bins=total_bins, level=level))
         if level == 0:
             full = left
         else:
             full = jnp.stack([left, prev_full - left],
                              axis=2).reshape(2, n_nodes, total_bins)
         prev_full = full
-        totals = node_totals(node, g, h, n_nodes=n_nodes)
+        totals = rf(node_totals(node, g, h, n_nodes=n_nodes))
         feat, thr, dirv, gain = sparse_best_split(
             full, totals, bin_ptr_d, feat_of_bin_d, last_mask,
             dense_pos_d, n_dense=n_dense, b_max=b_max,
@@ -168,7 +176,7 @@ def _sparse_round_core(row_e, gb_e, g, h, bin_ptr_d, feat_of_bin_d,
         gains.append(gain)
     node = route_level(row_e, gb_e, node, feat, thr, dirv,
                        bin_ptr_d, feat_of_bin_d)
-    lt = node_totals(node, g, h, n_nodes=n_leaf)
+    lt = rf(node_totals(node, g, h, n_nodes=n_leaf))
     leaf = (-_maybe_l1(lt[0], alpha) / (lt[1] + lam)
             * eta).astype(jnp.float32)
     return _pack_tree(feats, thrs, dirs, gains, leaf, half=half), node, leaf
@@ -243,13 +251,30 @@ class SparseHistGBT:
     # -- training -------------------------------------------------------
     def fit(self, offset, index, value, y,
             weight: Optional[np.ndarray] = None,
-            n_features: Optional[int] = None) -> "SparseHistGBT":
+            n_features: Optional[int] = None,
+            cuts: Optional[SparseCuts] = None,
+            distributed: Optional[bool] = None) -> "SparseHistGBT":
         """Boost ``n_trees`` rounds over CSR rows.
 
         ``n_features`` pins the feature-space width (else
         ``max(index)+1``) — pass it when shards/batches may not touch
-        the top feature id.
+        the top feature id.  ``cuts`` injects precomputed ragged cuts
+        (else built from this input; distributed fits merge every
+        worker's candidates).
+
+        **Distributed** (auto when ``coll.world_size() > 1`` via the
+        DMLC env ABI; ``distributed=False`` forces a process-local fit
+        inside a cluster — e.g. a per-worker comparator): each worker
+        holds its OWN row shard; the candidate matrix
+        allgather merges global cuts, and per-level histograms / node
+        totals allreduce across workers (``allreduce_device``), so all
+        workers grow identical trees — the sparse engine's rabit-
+        allreduce replacement.  Runs the per-level host loop (the
+        collectives must interleave with the level kernels), so it
+        trades the fused-round dispatch amortization for scale-out.
         """
+        from dmlc_core_tpu.parallel import collectives as coll
+
         p = self.param
         offset, index, value = self._csr(offset, index, value)
         y = np.ascontiguousarray(y, np.float32)
@@ -257,6 +282,13 @@ class SparseHistGBT:
         CHECK_EQ(len(y), n, "y/offset row mismatch")
         weight = fold_scale_pos_weight(p, y, weight)  # spw ≡ inst weight
         F = int(n_features or (index.max() + 1 if len(index) else 1))
+        if distributed is None:
+            distributed = coll.world_size() > 1
+        if distributed:
+            # sparse shards can disagree on the max feature id; cuts,
+            # bins and histograms need ONE global F
+            F = int(coll.allreduce(np.asarray([F], np.int64),
+                                   op="max")[0])
         CHECK(len(index) == 0 or int(index.max()) < F,
               "n_features smaller than max feature index")
         CHECK(F <= 1 << 24,
@@ -266,7 +298,23 @@ class SparseHistGBT:
         self.n_features = F
 
         t0 = get_time()
-        self.cuts = build_sparse_cuts(index, value, F, p.n_bins)
+        if cuts is not None:
+            CHECK_EQ(cuts.n_features, F,
+                     "injected cuts' feature count != n_features")
+            self.cuts = cuts
+        elif distributed:
+            msg_mb = F * (p.n_bins - 1) * 4 >> 20
+            if msg_mb > 256:
+                LOG("WARNING", "distributed sparse cuts: the [F, "
+                    "n_bins-1] candidate allgather is %d MB/worker at "
+                    "F=%d — drop n_bins (sparse features rarely need "
+                    "256) or precompute cuts= once and inject them",
+                    msg_mb, F)
+            cand = sparse_cut_candidates(index, value, F, p.n_bins)
+            gathered = np.asarray(coll.allgather(cand))   # [W, F, nb]
+            self.cuts = merge_sparse_cut_candidates(gathered)
+        else:
+            self.cuts = build_sparse_cuts(index, value, F, p.n_bins)
         gb = bin_sparse_entries(index, value, self.cuts)
         rows = csr_rows(offset)
         TB = self.cuts.total_bins
@@ -318,7 +366,11 @@ class SparseHistGBT:
             })
 
         rng = np.random.default_rng(p.seed)
-        if p.subsample >= 1.0:
+        if distributed:
+            preds = self._fit_rounds_distributed(
+                row_e, gb_e, y_d, w_d, preds, bin_ptr_d, feat_of_bin_d,
+                last_mask, dense_pos_d, cfg, unpack, coll, n)
+        elif p.subsample >= 1.0:
             # K rounds per dispatch; the [K, L] packed trees are ONE
             # fetch per chunk
             K = int(get_env("DMLC_TPU_SPARSE_ROUNDS_PER_DISPATCH", 8,
@@ -349,14 +401,46 @@ class SparseHistGBT:
         self._train_margin = preds
         return self
 
+    def _fit_rounds_distributed(self, row_e, gb_e, y_d, w_d, preds,
+                                bin_ptr_d, feat_of_bin_d, last_mask,
+                                dense_pos_d, cfg, unpack, coll, n):
+        """Per-round boosting with cross-worker collectives.
+
+        Runs the SAME tree-growing core as the local engines with
+        ``reduce_fn=allreduce_device`` summing every histogram and
+        node-total across workers between the level kernels — split
+        choices, and therefore trees, are identical on every rank.
+        Eager (unjitted) so the collectives interleave; subsample draws
+        come from a rank-seeded host RNG (each worker samples its own
+        shard, the ext engine's convention)."""
+        p = self.param
+        rngr = np.random.default_rng([p.seed, coll.rank()])
+        for r in range(p.n_trees):
+            g, h = self._obj.grad_hess(preds, y_d)
+            wk = w_d
+            if p.subsample < 1.0:
+                keep = (rngr.random(n) < p.subsample).astype(np.float32)
+                wk = w_d * jnp.asarray(keep)
+            flat, node, leaf = _sparse_round_core(
+                row_e, gb_e, g * wk, h * wk, bin_ptr_d, feat_of_bin_d,
+                last_mask, dense_pos_d,
+                reduce_fn=coll.allreduce_device, **cfg)
+            preds = _leaf_update(preds, node, leaf)
+            unpack(np.asarray(flat))
+        return preds
+
     def fit_block(self, block, y=None, weight: Optional[np.ndarray] = None,
-                  n_features: Optional[int] = None) -> "SparseHistGBT":
+                  n_features: Optional[int] = None,
+                  cuts: Optional[SparseCuts] = None,
+                  distributed: Optional[bool] = None) -> "SparseHistGBT":
         """Train from a :class:`RowBlock` (labels/weights from the block
-        unless overridden)."""
+        unless overridden; ``cuts``/``distributed`` forward to
+        :meth:`fit`)."""
         return self.fit(block.offset, block.index, block.value,
                         block.label if y is None else y,
                         weight=block.weight if weight is None else weight,
-                        n_features=n_features)
+                        n_features=n_features, cuts=cuts,
+                        distributed=distributed)
 
     # -- inference ------------------------------------------------------
     def predict_block(self, block, **kw) -> np.ndarray:

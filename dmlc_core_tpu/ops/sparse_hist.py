@@ -48,7 +48,8 @@ import jax.numpy as jnp
 
 from dmlc_core_tpu.base.logging import CHECK
 
-__all__ = ["SparseCuts", "build_sparse_cuts", "bin_sparse_entries",
+__all__ = ["SparseCuts", "build_sparse_cuts", "sparse_cut_candidates",
+           "merge_sparse_cut_candidates", "bin_sparse_entries",
            "csr_rows", "level_histogram", "node_totals",
            "sparse_best_split", "route_level"]
 
@@ -76,15 +77,20 @@ class SparseCuts(NamedTuple):
         return int(self.bin_ptr[-1])
 
 
-def build_sparse_cuts(cols: np.ndarray, values: np.ndarray, n_features: int,
-                      max_bins: int = 256) -> SparseCuts:
-    """Per-feature quantile cuts over PRESENT values, fully vectorized.
+def sparse_cut_candidates(cols: np.ndarray, values: np.ndarray,
+                          n_features: int,
+                          max_bins: int = 256) -> np.ndarray:
+    """Per-feature cut CANDIDATES ``[F, max_bins-1]`` (f32; all-NaN row
+    for a feature with no local entries), fully vectorized.
 
     One ``lexsort`` of the nnz entries by (feature, value), then every
-    feature's cut candidates are gathered at evenly spaced ranks of its
-    own segment and de-duplicated — no per-feature Python loop (F can be
-    10⁶).  Unweighted ranks (the sparse path's v1 contract; the dense
-    engine keeps weighted sketches).
+    feature's candidates are gathered at evenly spaced ranks of its own
+    segment — no per-feature Python loop (F can be 10⁶).  Unweighted
+    ranks (the sparse path's v1 contract; the dense engine keeps
+    weighted sketches).  This fixed-shape matrix is also the
+    distributed message: workers allgather their candidate matrices and
+    :func:`merge_sparse_cut_candidates` re-quantiles the union —
+    the sparse analogue of the dense cut allgather-merge.
     """
     CHECK(max_bins >= 2, "need at least 2 bins")
     cols = np.asarray(cols)
@@ -92,6 +98,7 @@ def build_sparse_cuts(cols: np.ndarray, values: np.ndarray, n_features: int,
     CHECK(len(cols) == len(values), "cols/values length mismatch")
     if len(cols):
         CHECK(int(cols.max()) < n_features, "feature index out of range")
+        CHECK(int(cols.min()) >= 0, "negative feature index")
         CHECK(np.isfinite(values).all(),
               "sparse values must be finite (absent entries ARE the "
               "missing mass; explicit NaN has no sparse meaning)")
@@ -108,21 +115,60 @@ def build_sparse_cuts(cols: np.ndarray, values: np.ndarray, n_features: int,
         np.maximum(m - 1, 0))
     cand = cv[np.minimum(idx, len(cv) - 1 if len(cv) else 0)] \
         if len(cv) else np.zeros((n_features, nb), np.float32)  # [F, nb]
+    cand[counts == 0] = np.nan
+    return cand
+
+
+def merge_sparse_cut_candidates(cands: np.ndarray) -> SparseCuts:
+    """Merge ``[W, F, max_bins-1]`` worker candidate matrices into
+    ragged :class:`SparseCuts`.
+
+    Per feature the union of the workers' candidate points is
+    re-quantiled onto the candidates' own grid width (NaN rows — workers
+    whose shard lacked the feature — contribute nothing; like the dense
+    ``merge_summaries``, worker summaries weigh equally, which is exact
+    for the similar-size shards data-parallel splits produce).  With
+    ``W = 1`` the merge is the identity on the candidates, so single-
+    and multi-worker paths share one code path.  De-duplication keeps
+    strictly increasing runs; a feature with no finite candidate
+    anywhere keeps 0 cuts (1 bin, never a split).
+    """
+    cands = np.asarray(cands, np.float32)
+    W, F, nb = cands.shape
+    pts = np.sort(cands.transpose(1, 0, 2).reshape(F, W * nb), axis=1)
+    m = (~np.isnan(pts)).sum(axis=1, keepdims=True)           # [F, 1]
+    k = np.arange(1, nb + 1)                                  # [nb]
+    # candidate j of a worker sits at quantile (j+1)/(nb+1) of its
+    # shard; selecting rank ceil(k·(m+1)/(nb+1))−1 of the union puts
+    # target k/(nb+1) back on the same grid — and makes W=1 the exact
+    # identity on the candidates
+    idx = np.clip(np.ceil(k[None, :] * (m + 1) / (nb + 1)).astype(
+        np.int64) - 1, 0, np.maximum(m - 1, 0))
+    cand = np.take_along_axis(pts, idx, axis=1)               # [F, nb]
     # keep strictly increasing runs only; empty features keep 0 cuts.
     # A cut equal to the feature's MINIMUM value is useless as a
     # threshold only if nothing sorts below it — but bin-of-value uses
     # "#cuts ≤ v", so any duplicate-free subset is valid.
     keep = np.ones_like(cand, bool)
     keep[:, 1:] = cand[:, 1:] > cand[:, :-1]
-    keep[counts == 0] = False
+    keep[m[:, 0] == 0] = False
+    keep &= ~np.isnan(cand)
     ncuts = keep.sum(axis=1)                                  # [F]
     cut_ptr = np.concatenate([[0], np.cumsum(ncuts)])
     cut_vals = cand[keep].astype(np.float32)
     widths = ncuts + 1
     bin_ptr = np.concatenate([[0], np.cumsum(widths)])
-    feat_of_bin = np.repeat(np.arange(n_features, dtype=np.int32), widths)
+    feat_of_bin = np.repeat(np.arange(F, dtype=np.int32), widths)
     return SparseCuts(cut_vals, cut_ptr.astype(np.int64),
                       bin_ptr.astype(np.int64), feat_of_bin)
+
+
+def build_sparse_cuts(cols: np.ndarray, values: np.ndarray, n_features: int,
+                      max_bins: int = 256) -> SparseCuts:
+    """Single-worker cuts: candidates → (W=1) merge.  One code path
+    with the distributed build, which allgathers the candidate stage."""
+    cand = sparse_cut_candidates(cols, values, n_features, max_bins)
+    return merge_sparse_cut_candidates(cand[None])
 
 
 def bin_sparse_entries(cols: np.ndarray, values: np.ndarray,

@@ -591,6 +591,109 @@ class TestMultiProcessLocal:
         np.testing.assert_array_equal(recovered.predict(X),
                                       ref.predict(X))
 
+    def test_local_launch_sparse_histgbt_parity(self, tmp_path):
+        """Distributed SparseHistGBT across real processes (r5): each
+        worker holds its OWN disjoint row shard; global cuts come from
+        the candidate-matrix allgather and per-level histograms / node
+        totals allreduce across workers.  With the SAME injected cuts,
+        the 2-shard distributed fit must match a single-process fit of
+        the full data tree-for-tree (the sparse engine's rabit-allreduce
+        seam, like the dense parity test)."""
+        script = tmp_path / "sparse_worker.py"
+        script.write_text(textwrap.dedent(
+            """
+            from dmlc_core_tpu.utils import force_cpu_devices
+            force_cpu_devices(1)
+            import numpy as np
+            from dmlc_core_tpu.parallel import collectives as coll
+            coll.init()
+            from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+            from dmlc_core_tpu.ops.sparse_hist import build_sparse_cuts
+
+            r, w = coll.rank(), coll.world_size()
+            assert w == 2, w
+            rng = np.random.default_rng(17)
+            n, F = 600, 30
+            mask = rng.random((n, F)) < 0.2
+            mask[:, 0] |= rng.random(n) < 0.5
+            vals = rng.normal(size=(n, F)).astype(np.float32)
+            score = np.where(mask[:, 0], vals[:, 0], -0.5)
+            y = (score > 0).astype(np.float32)
+            offset = np.concatenate([[0], np.cumsum(mask.sum(axis=1))])
+            index = np.nonzero(mask)[1]
+            value = vals[mask]
+            # one shared cut grid isolates the histogram-allreduce seam
+            cuts = build_sparse_cuts(index, value, F, 16)
+
+            def shard(lo, hi):
+                keep = slice(offset[lo], offset[hi])
+                off = offset[lo:hi + 1] - offset[lo]
+                return off, index[keep], value[keep], y[lo:hi]
+
+            half = n // 2
+            mine = shard(0, half) if r == 0 else shard(half, n)
+            # 2 rounds at moderate lr: by round 3 this easy
+            # problem's gradients shrink to near-ties, where f32
+            # summation order (allreduce vs single-pass) can flip a
+            # threshold or a missing-direction flag — the same property
+            # as the dense engine's psum rounding (see
+            # test_local_launch_histgbt_training_parity); the early
+            # rounds are the exact-parity window
+            kw = dict(n_trees=2, max_depth=3, n_bins=16,
+                      learning_rate=0.3)
+            dist = SparseHistGBT(**kw)
+            dist.fit(*mine, n_features=F, cuts=cuts)
+            solo = SparseHistGBT(**kw)
+            solo.fit(offset, index, value, y, n_features=F, cuts=cuts,
+                     distributed=False)
+            assert len(dist.trees) == len(solo.trees) == 2
+            for i, (td, ts) in enumerate(zip(dist.trees, solo.trees)):
+                assert np.array_equal(td["feat"], ts["feat"]), (r, i)
+                assert np.array_equal(td["thr"], ts["thr"]), (r, i)
+                assert np.array_equal(td["dir"], ts["dir"]), (r, i)
+                np.testing.assert_allclose(td["leaf"], ts["leaf"],
+                                           rtol=2e-5, atol=2e-6)
+            # and the distributed model scores the FULL data like
+            # the solo model, well above chance
+            pred = dist.predict(offset, index, value)
+            np.testing.assert_allclose(
+                pred, solo.predict(offset, index, value),
+                rtol=1e-5, atol=1e-6)
+            acc = ((pred > 0.5) == y).mean()
+            assert acc > 0.85, (r, acc)
+
+            # the DEFAULT distributed path (no injected cuts): global
+            # cuts from the candidate-matrix allgather-merge; workers
+            # must agree bit-for-bit (checked via allreduce min==max)
+            auto = SparseHistGBT(**kw)
+            auto.fit(*mine, n_features=F)
+            flat = np.concatenate(
+                [t[k].astype(np.float32).ravel()
+                 for t in auto.trees for k in ("feat", "thr", "leaf")])
+            mn = coll.allreduce(flat, op="min")
+            mx = coll.allreduce(flat, op="max")
+            np.testing.assert_array_equal(mn, mx)
+            acc2 = ((auto.predict(offset, index, value) > 0.5)
+                    == y).mean()
+            assert acc2 > 0.85, (r, acc2)
+            print(f"worker {r}/{w}: sparse distributed parity OK",
+                  flush=True)
+            """
+        ))
+        from dmlc_core_tpu.tracker import local as local_backend
+
+        codes = []
+
+        def fun_submit(n_, envs):
+            env = dict(envs)
+            env["PYTHONPATH"] = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            codes.extend(local_backend.launch(
+                2, [sys.executable, str(script)], env, timeout=240))
+
+        tracker_submit(2, 0, fun_submit, host_ip="127.0.0.1")
+        assert codes == [0, 0]
+
     def test_local_launch_histgbt_missing_mode(self, tmp_path):
         """Missing-value training across real processes: NaN rows all
         land in rank 0's addressable shard, so rank 1 sees no local NaN

@@ -146,6 +146,51 @@ def _brute_first_tree(bins, present, y, widths, *, lam, gamma, mcw,
     return levels, leaf, node
 
 
+class TestCandidateMerge:
+    def test_w1_merge_is_identity_with_build(self):
+        rng = np.random.default_rng(3)
+        from dmlc_core_tpu.ops.sparse_hist import (
+            build_sparse_cuts, merge_sparse_cut_candidates,
+            sparse_cut_candidates)
+        cols = rng.integers(0, 23, 600)
+        vals = np.round(rng.normal(size=600), 1).astype(np.float32)
+        a = build_sparse_cuts(cols, vals, 23, 8)
+        b = merge_sparse_cut_candidates(
+            sparse_cut_candidates(cols, vals, 23, 8)[None])
+        np.testing.assert_array_equal(a.cut_vals, b.cut_vals)
+        np.testing.assert_array_equal(a.cut_ptr, b.cut_ptr)
+
+    def test_two_shard_merge_approximates_global(self):
+        rng = np.random.default_rng(5)
+        from dmlc_core_tpu.ops.sparse_hist import (
+            build_sparse_cuts, merge_sparse_cut_candidates,
+            sparse_cut_candidates)
+        F, nnz = 11, 4000
+        cols = rng.integers(0, F, nnz)
+        cols[cols == 4] = 5               # feature 4 globally empty
+        vals = rng.normal(size=nnz).astype(np.float32)
+        halves = [slice(0, nnz // 2), slice(nnz // 2, nnz)]
+        cands = np.stack([
+            sparse_cut_candidates(cols[s], vals[s], F, 16)
+            for s in halves])
+        merged = merge_sparse_cut_candidates(cands)
+        solo = build_sparse_cuts(cols, vals, F, 16)
+        assert merged.n_features == F
+        assert merged.bin_ptr[5] - merged.bin_ptr[4] == 1   # empty feat
+        for f in range(F):
+            mg = merged.cut_vals[merged.cut_ptr[f]:merged.cut_ptr[f + 1]]
+            sg = solo.cut_vals[solo.cut_ptr[f]:solo.cut_ptr[f + 1]]
+            assert (np.diff(mg) > 0).all()
+            if len(sg) and len(mg):
+                # merged cuts track the global quantile grid closely
+                lm = min(len(sg), len(mg))
+                assert np.abs(np.interp(
+                    np.linspace(0, 1, lm), np.linspace(0, 1, len(mg)),
+                    mg) - np.interp(
+                    np.linspace(0, 1, lm), np.linspace(0, 1, len(sg)),
+                    sg)).max() < 0.35
+
+
 class TestSparseEngineOracle:
     @pytest.mark.parametrize("depth,mcw,gamma", [(3, 1.0, 0.0),
                                                  (2, 4.0, 0.05)])
