@@ -486,6 +486,33 @@ class SparseHistGBT:
             return out
         return np.asarray(self._obj.transform(jnp.asarray(out)))
 
+    # -- introspection --------------------------------------------------
+    def feature_importances(self, importance_type: str = "weight"
+                            ) -> np.ndarray:
+        """Per-feature importance over the ensemble (``"weight"`` =
+        count of real splits, ``"gain"`` = total split gain — XGBoost's
+        notions).  Degenerate/padding slots carry gain 0 (the split
+        chooser writes gain only when it beats gamma), so ``gain > 0``
+        identifies genuine splits."""
+        CHECK(len(self.trees) > 0, "no trees trained")
+        CHECK(importance_type in ("weight", "gain"),
+              f"unsupported importance_type {importance_type!r}")
+        out = np.zeros(self.n_features,
+                       np.float64 if importance_type == "gain"
+                       else np.int64)
+        for tree in self.trees:
+            for level in range(tree["feat"].shape[0]):
+                nn = 1 << level
+                feat = tree["feat"][level][:nn]
+                gain = tree["gain"][level][:nn]
+                real = gain > 0
+                if importance_type == "weight":
+                    out += np.bincount(feat[real],
+                                       minlength=self.n_features)
+                else:
+                    np.add.at(out, feat[real], gain[real])
+        return out
+
     # -- persistence ----------------------------------------------------
     def save_model(self, uri: str) -> None:
         """Params + ragged cuts + trees to any Stream URI."""

@@ -142,6 +142,75 @@ class _EstimatorBase(_SkBase):
         kw.update(self._extra)
         return HistGBT(**kw)
 
+    # -- scipy.sparse routing (XGBClassifier accepts sparse X) ----------
+    @staticmethod
+    def _is_scipy_sparse(X) -> bool:
+        return hasattr(X, "tocsr") and not isinstance(X, np.ndarray)
+
+    def _make_sparse(self, objective: str):
+        from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+
+        CHECK(self.booster == "gbtree",
+              "sparse input needs the tree booster (densify for "
+              "gblinear, or use GBLinear.fit_iter's CSR path)")
+        kw: Dict[str, Any] = dict(
+            n_trees=self.n_estimators, max_depth=self.max_depth,
+            learning_rate=self.learning_rate, n_bins=self.n_bins,
+            reg_lambda=self.reg_lambda, reg_alpha=self.reg_alpha,
+            subsample=self.subsample,
+            colsample_bytree=self.colsample_bytree,
+            objective=objective, seed=self.seed)
+        kw.update(self._extra)
+        return SparseHistGBT(**kw)
+
+    @staticmethod
+    def _csr_canon(X):
+        """scipy matrix → canonical CSR arrays (duplicates summed, the
+        sparse engine's one-entry-per-(row, feature) contract).  The
+        copy happens only when canonicalization would mutate the
+        caller's matrix — the common csr_matrix(dense)/tocsr() case is
+        already canonical and passes through zero-copy."""
+        csr = X.tocsr()
+        if not getattr(csr, "has_canonical_format", False):
+            csr = csr.copy()
+            csr.sum_duplicates()
+        return csr.indptr, csr.indices, csr.data, csr.shape[1]
+
+    def _fit_sparse(self, X, y_codes, objective, sample_weight, fit_kw):
+        CHECK(not fit_kw,
+              f"sparse input does not support {sorted(fit_kw)} "
+              "(eval_set/early stopping need the dense engine — "
+              "densify, or fit SparseHistGBT directly)")
+        self._model = self._make_sparse(objective)
+        indptr, indices, data, F = self._csr_canon(X)
+        self._model.fit(indptr, indices, data, y_codes,
+                        weight=sample_weight, n_features=F)
+        return self
+
+    def _predict_sparse_raw(self, X, **kw):
+        indptr, indices, data, _ = self._csr_canon(X)
+        return self._model.predict(indptr, indices, data, **kw)
+
+    def _raw_margin(self, X):
+        """Booster-raw predictions with SYMMETRIC input-type guards:
+        a sparse-fit model requires sparse X (dense zeros would mean
+        VALUES, not absence) and a dense-fit model requires dense X
+        (np.asarray on a scipy matrix dies with an unrelated
+        ValueError deep in the engine otherwise)."""
+        from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+
+        if isinstance(self.model, SparseHistGBT):
+            CHECK(self._is_scipy_sparse(X),
+                  "this model was fit on sparse input (absent ≡ "
+                  "missing) — pass a scipy.sparse matrix; a dense "
+                  "matrix's zeros would mean VALUES, not absence")
+            return self._predict_sparse_raw(X)
+        CHECK(not self._is_scipy_sparse(X),
+              "this model was fit on dense input — densify with "
+              "X.toarray(), or refit on the sparse matrix to get "
+              "absent ≡ missing semantics")
+        return self.model.predict(X)
+
     @property
     def model(self):
         """The underlying native booster (after fit)."""
@@ -211,6 +280,9 @@ class _EstimatorBase(_SkBase):
         ``pred_leaf``, the GBDT feature-embedding hook.  gbtree only."""
         CHECK(self.booster == "gbtree",
               "apply() needs the tree booster (booster='gbtree')")
+        CHECK(hasattr(self.model, "predict_leaf"),
+              "apply() is not available for sparse-input models "
+              "(SparseHistGBT has no predict_leaf yet)")
         return self.model.predict_leaf(X)
 
     def save_model(self, uri: str) -> None:
@@ -243,6 +315,15 @@ class GBTClassifier(_SkClf, _EstimatorBase):
                   "eval_set labels contain classes not present in y")
             fit_kw["eval_set"] = (
                 Xv, np.searchsorted(self.classes_, yv).astype(np.float32))
+        if self._is_scipy_sparse(X):
+            # XGBClassifier's sparse-DMatrix surface: absent entries are
+            # MISSING (sparsity-aware split finding) via SparseHistGBT
+            CHECK(n_class == 2,
+                  "sparse input supports binary classification "
+                  "(SparseHistGBT has no multi:softmax) — densify for "
+                  "multiclass")
+            return self._fit_sparse(X, codes, "binary:logistic",
+                                    sample_weight, fit_kw)
         if n_class == 2:
             self._model = self._make("binary:logistic")
         else:
@@ -251,14 +332,17 @@ class GBTClassifier(_SkClf, _EstimatorBase):
         return self
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        raw = self.model.predict(X)
+        raw = self._raw_margin(X)
         if len(self.classes_) == 2:
             return self.classes_[(np.asarray(raw) > 0.5).astype(int)]
         return self.classes_[np.asarray(raw).astype(int)]
 
     def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        if self.booster == "gblinear":
-            p1 = np.asarray(self.model.predict(X))
+        from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+
+        if self.booster == "gblinear" or isinstance(self.model,
+                                                    SparseHistGBT):
+            p1 = np.asarray(self._raw_margin(X))
             return np.stack([1.0 - p1, p1], axis=1)
         return self.model.predict_proba(X)
 
@@ -273,6 +357,10 @@ class GBTRegressor(_SkReg, _EstimatorBase):
     def fit(self, X: np.ndarray, y: np.ndarray,
             sample_weight: Optional[np.ndarray] = None,
             **fit_kw: Any) -> "GBTRegressor":
+        if self._is_scipy_sparse(X):
+            return self._fit_sparse(X, np.asarray(y, np.float32),
+                                    "reg:squarederror", sample_weight,
+                                    fit_kw)
         self._model = self._make("reg:squarederror")
         fit_kw = self._watch_eval_set(fit_kw)
         self._model.fit(X, np.asarray(y, np.float32),
@@ -280,7 +368,7 @@ class GBTRegressor(_SkReg, _EstimatorBase):
         return self
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return np.asarray(self.model.predict(X))
+        return np.asarray(self._raw_margin(X))
 
     def score(self, X: np.ndarray, y: np.ndarray) -> float:
         """R² (sklearn regressor convention)."""

@@ -245,3 +245,95 @@ class TestEvalsResult:
         est = GBTClassifier(n_estimators=5, max_depth=2, n_bins=16)
         est.fit(X, yb, eval_set=[Xv, ybv])
         assert list(est.evals_result()) == ["validation_0"]
+
+
+class TestScipySparseInput:
+    """XGBClassifier/XGBRegressor accept scipy.sparse X; the wrappers
+    route it to SparseHistGBT (absent ≡ missing — XGBoost's sparse
+    DMatrix semantics, NOT densify-to-zero)."""
+
+    def _csr_problem(self, n=500, F=60, seed=0):
+        import scipy.sparse as sp
+        rng = np.random.default_rng(seed)
+        mask = rng.random((n, F)) < 0.15
+        mask[:, 0] |= rng.random(n) < 0.5
+        vals = rng.normal(size=(n, F)).astype(np.float32)
+        y = (np.where(mask[:, 0], vals[:, 0], -0.5) > 0).astype(int)
+        X = sp.csr_matrix(np.where(mask, vals, 0.0))
+        return X, y
+
+    def test_classifier_sparse_fit_predict(self):
+        from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+        X, y = self._csr_problem()
+        clf = GBTClassifier(n_estimators=15, max_depth=3, n_bins=16,
+                            learning_rate=0.4)
+        clf.fit(X, y)
+        assert isinstance(clf.model, SparseHistGBT)
+        assert (clf.predict(X) == y).mean() > 0.9
+        proba = clf.predict_proba(X)
+        assert proba.shape == (X.shape[0], 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+        imp = clf.feature_importances_
+        assert imp.shape == (X.shape[1],)
+        assert imp.argmax() == 0          # the signal feature dominates
+
+    def test_regressor_sparse(self):
+        import scipy.sparse as sp
+        rng = np.random.default_rng(3)
+        X, _ = self._csr_problem(seed=3)
+        d = np.asarray(X.todense())
+        target = (np.where(d[:, 0] != 0, d[:, 0], -1.0)).astype(np.float32)
+        reg = GBTRegressor(n_estimators=25, max_depth=3, n_bins=32,
+                           learning_rate=0.3)
+        reg.fit(X, target)
+        pred = reg.predict(X)
+        rmse = float(np.sqrt(np.mean((pred - target) ** 2)))
+        assert rmse < 0.45 * target.std()
+
+    def test_dense_model_rejects_sparse_predict(self):
+        import scipy.sparse as sp
+        from dmlc_core_tpu.base.logging import Error
+        rng = np.random.default_rng(9)
+        Xd = rng.normal(size=(200, 8)).astype(np.float32)
+        yd = (Xd[:, 0] > 0).astype(int)
+        clf = GBTClassifier(n_estimators=4, max_depth=2, n_bins=16)
+        clf.fit(Xd, yd)
+        with pytest.raises(Error, match="densify"):
+            clf.predict(sp.csr_matrix(Xd))
+
+    def test_sparse_model_rejects_dense_predict(self):
+        from dmlc_core_tpu.base.logging import Error
+        X, y = self._csr_problem(seed=5)
+        clf = GBTClassifier(n_estimators=4, max_depth=2, n_bins=16)
+        clf.fit(X, y)
+        with pytest.raises(Error, match="sparse"):
+            clf.predict(np.asarray(X.todense()))
+        with pytest.raises(Error, match="sparse"):
+            clf.apply(X)
+
+    def test_sparse_rejections(self):
+        from dmlc_core_tpu.base.logging import Error
+        X, y = self._csr_problem(seed=7)
+        y3 = y.copy()
+        y3[:5] = 2
+        with pytest.raises(Error, match="binary"):
+            GBTClassifier(n_estimators=2).fit(X, y3)
+        with pytest.raises(Error, match="eval_set|does not support"):
+            GBTClassifier(n_estimators=2).fit(
+                X, y, eval_set=(np.zeros((2, 60)), np.zeros(2)))
+        with pytest.raises(Error, match="tree booster"):
+            GBTClassifier(booster="gblinear", n_estimators=2).fit(X, y)
+
+    def test_duplicates_summed_by_canonicalization(self):
+        import scipy.sparse as sp
+        # COO with duplicate (row, col) entries: scipy keeps them until
+        # sum_duplicates; the wrapper canonicalizes so the sparse
+        # engine's no-duplicate contract holds
+        rows = np.array([0, 0, 1, 1, 1])
+        cols = np.array([0, 0, 1, 1, 2])
+        vals = np.array([1.0, 2.0, 0.5, 0.5, 3.0], np.float32)
+        X = sp.coo_matrix((vals, (rows, cols)), shape=(2, 3))
+        y = np.array([0, 1])
+        clf = GBTClassifier(n_estimators=1, max_depth=1, n_bins=4)
+        clf.fit(X, y)                      # must not raise
+        assert clf.predict(X.tocsr()).shape == (2,)
