@@ -122,6 +122,51 @@ def _live_estimate():
     return done / t / EV["config"].get("chips", 1)
 
 
+def _metrics_out_path():
+    """--metrics-out PATH / --metrics-out=PATH / BENCH_METRICS_OUT env —
+    where to archive the full metrics snapshot (None = don't)."""
+    for i, a in enumerate(sys.argv):
+        if a == "--metrics-out" and i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+        if a.startswith("--metrics-out="):
+            return a.split("=", 1)[1]
+    return os.environ.get("BENCH_METRICS_OUT")
+
+
+def _attach_metrics(out):
+    """Final-record metrics: archive the full registry snapshot when
+    --metrics-out/BENCH_METRICS_OUT names a path, and inline a compact
+    phase breakdown (the BENCH_* artifact now says where the time went,
+    not only how much there was).  Never fatal — the headline record
+    must survive a metrics failure."""
+    try:
+        from dmlc_core_tpu.base.metrics import default_registry
+
+        reg = default_registry()
+        path = _metrics_out_path()
+        if path:
+            out["metrics_path"] = reg.save_json(path)
+        snap = reg.snapshot()["metrics"]
+        summary = {}
+        ph = snap.get("dmlc_gbt_phase_seconds")
+        if ph:
+            for se in ph["series"]:
+                lab = se["labels"]
+                key = f"{lab['engine']}_{lab['phase']}"
+                summary[f"{key}_p50_s"] = se["quantiles"]["p50"]
+                summary[f"{key}_count"] = se["count"]
+        for name, field in (("dmlc_gbt_rounds_total", "rounds_total"),
+                            ("dmlc_collective_bytes_total",
+                             "collective_bytes_total")):
+            m = snap.get(name)
+            if m and m["series"]:
+                summary[field] = sum(s["value"] for s in m["series"])
+        if summary:
+            out["metrics_summary"] = summary
+    except Exception as e:  # noqa: BLE001
+        out["metrics_error"] = f"{type(e).__name__}: {e}"[:200]
+
+
 def emit(final=False, **extra):
     """Print one JSON evidence line (the driver reads the LAST line)."""
     cfg = EV["config"]
@@ -164,6 +209,8 @@ def emit(final=False, **extra):
         out[k] = v
     if EV["notes"]:
         out["notes"] = EV["notes"]
+    if final:
+        _attach_metrics(out)
     out.update(extra)
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(out) + "\n")

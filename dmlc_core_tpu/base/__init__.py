@@ -1,5 +1,13 @@
 """Base layer (L0–L1): logging/CHECK/Error, timer, env, registry, parameter,
-config, thread-local store.  Reference: include/dmlc/{logging,timer,parameter,
-registry,config,thread_local}.h (see SURVEY.md §2a)."""
+config, thread-local store, metrics.  Reference: include/dmlc/{logging,timer,
+parameter,registry,config,thread_local}.h (see SURVEY.md §2a); the metrics
+registry is this framework's own (the reference has none — SURVEY.md §5)."""
 
+from dmlc_core_tpu.base.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
 from dmlc_core_tpu.base.thread_local import ThreadLocalStore  # noqa: F401

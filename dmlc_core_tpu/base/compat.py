@@ -1,0 +1,69 @@
+"""JAX version compatibility shims.
+
+The substrate targets current JAX (``jax.shard_map`` with the
+``check_vma`` kwarg), but must still import — and run its tier-1 suite —
+on older runtimes where ``shard_map`` lives in ``jax.experimental`` and
+the replication check is spelled ``check_rep``.  Every in-package
+``shard_map`` consumer imports it from here instead of from ``jax``, so
+the version split is decided exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["shard_map", "donation_safe", "donate_argnums", "axis_size"]
+
+try:
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+    _KWARG_RENAME = None
+except ImportError:  # pre-0.6 JAX: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _KWARG_RENAME = ("check_vma", "check_rep")
+
+
+def donation_safe() -> bool:
+    """Whether buffer donation is trusted on this runtime.
+
+    On the legacy (experimental-shard_map) JAX/CPU combination, donated
+    inputs whose buffers alias a jit output are intermittently handed
+    back to the allocator while the aliased output is still live —
+    later dispatches then scribble over the head of a buffer the caller
+    still reads (observed as denormal garbage in the first vector lane
+    of boosted margins, ~1-in-6 runs of the external-memory suite).
+    Donation is a memory optimization, never a semantic one, so the
+    legacy runtime simply runs without it.
+    """
+    return _KWARG_RENAME is None
+
+
+def donate_argnums(*nums: int):
+    """``donate_argnums=compat.donate_argnums(3)`` — the requested
+    donation on runtimes where it is safe, no donation elsewhere."""
+    return nums if donation_safe() else ()
+
+
+def axis_size(axis_name: str) -> int:
+    """``jax.lax.axis_size`` where it exists; the classic
+    ``psum(1, axis)`` constant fold (static under tracing) on legacy
+    runtimes that predate it."""
+    import jax
+
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f: Optional[Callable[..., Any]] = None, **kwargs: Any):
+    """Call through to the runtime's shard_map, translating kwargs.
+
+    Usable both directly (``shard_map(fn, mesh=..., ...)``) and via
+    ``partial(shard_map, mesh=..., ...)`` as a decorator, matching the
+    real API's two spellings.
+    """
+    if _KWARG_RENAME is not None and _KWARG_RENAME[0] in kwargs:
+        kwargs[_KWARG_RENAME[1]] = kwargs.pop(_KWARG_RENAME[0])
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)

@@ -15,15 +15,19 @@ consumption — the same pipeline shape the TPU infeed path reuses
 
 from __future__ import annotations
 
+import contextlib
 from typing import Iterator, Optional
 
 import numpy as np
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK
+from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.data.parsers import Parser, parse_uri_spec
 from dmlc_core_tpu.data.row_block import RowBlock, RowBlockContainer
 from dmlc_core_tpu.io.stream import Stream
 from dmlc_core_tpu.io.threaded_iter import ThreadedIter
+from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
 __all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter",
            "iter_dense_slabs"]
@@ -31,6 +35,29 @@ __all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter",
 # target bytes per cache page (reference uses a row-count heuristic; byte
 # budget maps better to fixed host-staging buffers)
 _PAGE_BYTES = 64 << 20
+
+_DM = None
+
+
+def _data_metrics():
+    """``path="build"`` counts the pass-1 parse→cache write; ``"replay"``
+    counts cache-hit page reads on later epochs — the external-memory
+    question (is this run paying the parse again?) answered by two
+    counters."""
+    global _DM
+    if _DM is None:
+        r = _metrics.default_registry()
+        _DM = {
+            "pages": r.counter("data_pages_total",
+                               "row-block pages through DiskRowIter",
+                               labels=("path",)),
+            "rows": r.counter("data_page_rows_total",
+                              "rows through DiskRowIter pages",
+                              labels=("path",)),
+            "build_s": r.histogram("data_cache_build_seconds",
+                                   "DiskRowIter pass-1 cache build time"),
+        }
+    return _DM
 
 
 class RowBlockIter:
@@ -133,25 +160,35 @@ class DiskRowIter(RowBlockIter):
         self._read_stream: Optional[Stream] = None
 
     def _build_cache(self, parser: Parser, page_bytes: int) -> None:
-        out = Stream.create(self._cache_uri, "w")
-        container = RowBlockContainer()
-        held = 0
-        for block in parser:
-            container.push_block(block)
-            self._num_rows += block.size
-            held += block.memory_cost()
-            if held >= page_bytes:
+        t0 = get_time()
+        ctx = (global_tracer().scope("disk_row_iter.build_cache",
+                                     cache=self._cache_uri)
+               if tracing_enabled() else contextlib.nullcontext())
+        with ctx:
+            out = Stream.create(self._cache_uri, "w")
+            container = RowBlockContainer()
+            held = 0
+            for block in parser:
+                container.push_block(block)
+                self._num_rows += block.size
+                held += block.memory_cost()
+                if held >= page_bytes:
+                    container.save(out)
+                    self._num_pages += 1
+                    self._max_index = max(self._max_index, container.max_index)
+                    container.clear()
+                    held = 0
+            if container.size:
                 container.save(out)
                 self._num_pages += 1
                 self._max_index = max(self._max_index, container.max_index)
-                container.clear()
-                held = 0
-        if container.size:
-            container.save(out)
-            self._num_pages += 1
-            self._max_index = max(self._max_index, container.max_index)
-        out.close()
-        parser.close()
+            out.close()
+            parser.close()
+        if _metrics.enabled():
+            m = _data_metrics()
+            m["pages"].inc(self._num_pages, path="build")
+            m["rows"].inc(self._num_rows, path="build")
+            m["build_s"].observe(get_time() - t0)
 
     def _start_reader(self) -> None:
         self._stop_reader()
@@ -161,13 +198,18 @@ class DiskRowIter(RowBlockIter):
             container = RowBlockContainer()
             if not container.load(self._read_stream):
                 return None
-            return container.to_block()
+            block = container.to_block()
+            if _metrics.enabled():
+                m = _data_metrics()
+                m["pages"].inc(1, path="replay")
+                m["rows"].inc(block.size, path="replay")
+            return block
 
         def rewind() -> None:
             self._read_stream.close()
             self._read_stream = Stream.create(self._cache_uri, "r")
 
-        self._iter = ThreadedIter(max_capacity=2)
+        self._iter = ThreadedIter(max_capacity=2, name="disk_row_iter")
         self._iter.init(next_page, rewind)
 
     def _stop_reader(self) -> None:

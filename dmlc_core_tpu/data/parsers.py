@@ -20,16 +20,37 @@ from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import Error, log_fatal
 from dmlc_core_tpu.base.parameter import Parameter, field
 from dmlc_core_tpu.base.registry import Registry
+from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.data import _native
 from dmlc_core_tpu.data.row_block import RowBlock
 from dmlc_core_tpu.io.input_split import InputSplit
+from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
 __all__ = ["Parser", "LibSVMParser", "CSVParser", "LibFMParser", "parse_uri_spec"]
 
 PARSER_REGISTRY: Registry = Registry.get("data_parser")
+
+_PM = None
+
+
+def _parser_metrics():
+    global _PM
+    if _PM is None:
+        r = _metrics.default_registry()
+        _PM = {
+            "bytes": r.counter("data_parse_bytes_total",
+                               "raw input bytes parsed", labels=("format",)),
+            "rows": r.counter("data_parse_rows_total",
+                              "rows produced by parsers", labels=("format",)),
+            "seconds": r.histogram("data_parse_seconds",
+                                   "per-chunk parse time",
+                                   labels=("format",)),
+        }
+    return _PM
 
 
 def parse_uri_spec(uri: str) -> Tuple[str, Dict[str, str], Optional[str]]:
@@ -66,6 +87,9 @@ class Parser:
     :class:`RowBlock` batches; ``bytes_read`` tracks raw input consumed.
     """
 
+    #: metrics label; each registered parser class overrides
+    format_name = "unknown"
+
     def __init__(self, split: InputSplit, nthread: int = 0):
         self._split = split
         self._nthread = nthread
@@ -100,7 +124,22 @@ class Parser:
             if chunk is None:
                 return
             self.bytes_read += len(chunk)
-            block = self._parse_chunk(chunk)
+            if _metrics.enabled():
+                m = _parser_metrics()
+                fmt = self.format_name
+                m["bytes"].inc(len(chunk), format=fmt)
+                t0 = get_time()
+                if tracing_enabled():
+                    with global_tracer().scope("parse", format=fmt,
+                                               bytes=len(chunk)):
+                        block = self._parse_chunk(chunk)
+                else:
+                    block = self._parse_chunk(chunk)
+                m["seconds"].observe(get_time() - t0, format=fmt)
+                if block is not None:
+                    m["rows"].inc(block.size, format=fmt)
+            else:
+                block = self._parse_chunk(chunk)
             if block is not None and block.size > 0:
                 yield block
 
@@ -129,6 +168,8 @@ class Parser:
 class LibSVMParser(Parser):
     """``label [qid:n] idx:val ...`` — XGBoost's classic input format."""
 
+    format_name = "libsvm"
+
     def __init__(self, path: str, part: int, nparts: int,
                  args: Optional[Dict[str, str]] = None, nthread: int = 0):
         super().__init__(InputSplit.create(path, part, nparts, "text"), nthread)
@@ -143,6 +184,8 @@ class LibSVMParser(Parser):
 class CSVParser(Parser):
     """Dense CSV → CSR (zeros kept, feature index = column position
     excluding label/weight columns)."""
+
+    format_name = "csv"
 
     def __init__(self, path: str, part: int, nparts: int,
                  args: Optional[Dict[str, str]] = None, nthread: int = 0):
@@ -165,6 +208,8 @@ class CSVParser(Parser):
 @PARSER_REGISTRY.register("libfm")
 class LibFMParser(Parser):
     """``label field:idx:val ...`` — field-aware FM format."""
+
+    format_name = "libfm"
 
     def __init__(self, path: str, part: int, nparts: int,
                  args: Optional[Dict[str, str]] = None, nthread: int = 0):

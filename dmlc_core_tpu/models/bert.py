@@ -31,7 +31,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from dmlc_core_tpu.base.compat import donate_argnums, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ
@@ -418,7 +419,7 @@ class BERT:
                                    {k: specs[k] for k in specs}, P()),
                         check_vma=False)
                     self._multi_cache[K] = jax.jit(
-                        mapped_k, donate_argnums=(0, 1))
+                        mapped_k, donate_argnums=donate_argnums(0, 1))
                 return self._multi_cache[K]
 
             self._make_multi = make_multi
@@ -429,7 +430,7 @@ class BERT:
             out_specs = ({k: specs[k] for k in specs}, gspecs, P())
         mapped = shard_map(step, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
-        donate = (0, 1) if fused else ()
+        donate = donate_argnums(0, 1) if fused else ()
         self._step_fn = jax.jit(mapped, donate_argnums=donate)
 
     # -- public API ----------------------------------------------------

@@ -19,12 +19,40 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, log_fatal
 from dmlc_core_tpu.ops.histogram import select_feature_bins
 
 __all__ = ["_make_best_split", "_advance_node", "_leaf_sums",
            "_soft_threshold", "_maybe_l1", "_host_bin_requested",
-           "_host_bin_t"]
+           "_host_bin_t", "gbt_metrics"]
+
+_GM = None
+
+
+def gbt_metrics():
+    """Shared GBT instrument handles (every engine — in-core, external,
+    sparse — reports into the same series, separated by the ``engine``
+    label)."""
+    global _GM
+    if _GM is None:
+        r = _metrics.default_registry()
+        _GM = {
+            "rounds": r.counter("gbt_rounds_total",
+                                "boosting rounds completed",
+                                labels=("engine",)),
+            "trees": r.counter("gbt_trees_total",
+                               "trees fetched to host",
+                               labels=("engine",)),
+            "phase": r.histogram(
+                "gbt_phase_seconds",
+                "per-phase wall time: bin (quantize+stage), round "
+                "(boost), warmup (compile), predict (score batch); with "
+                "DMLC_METRICS_GBT_PHASES=1 the external engine adds "
+                "hist/split/leaf/apply via block_until_ready",
+                labels=("engine", "phase")),
+        }
+    return _GM
 
 
 def _host_bin_requested() -> bool:

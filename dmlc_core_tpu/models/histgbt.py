@@ -38,12 +38,14 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dmlc_core_tpu.base.compat import donate_argnums, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG, log_fatal
 from dmlc_core_tpu.base.parameter import Parameter, field
 from dmlc_core_tpu.base.timer import get_time
+from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 from dmlc_core_tpu.ops.histogram import (build_histogram,
                                          fused_descend_histogram,
                                          select_feature_bins)
@@ -57,7 +59,7 @@ from dmlc_core_tpu.models.gbt_objectives import (  # noqa: F401  (re-exports:
     fold_scale_pos_weight)
 from dmlc_core_tpu.models.gbt_split import (  # noqa: F401  (re-exports)
     _advance_node, _host_bin_requested, _host_bin_t, _leaf_sums,
-    _make_best_split, _maybe_l1, _soft_threshold)
+    _make_best_split, _maybe_l1, _soft_threshold, gbt_metrics)
 from dmlc_core_tpu.models.histgbt_external import _ExternalMemoryEngine
 
 __all__ = ["HistGBT", "HistGBTParam", "OBJECTIVES"]
@@ -500,6 +502,9 @@ class HistGBT(_ExternalMemoryEngine):
                 np.asarray(warm[0][:1])
         np.asarray(preds[:1])
         self.last_warmup_seconds = get_time() - t_w
+        if _metrics.enabled() and warmup_rounds > 0:
+            gbt_metrics()["phase"].observe(self.last_warmup_seconds,
+                                           engine="incore", phase="warmup")
 
         t0 = get_time()
         chunks: List[Any] = []
@@ -521,10 +526,25 @@ class HistGBT(_ExternalMemoryEngine):
             # later chunks keep computing — so these in-order arrival
             # timestamps give per-chunk durations for free (see
             # ``last_chunk_times`` doc in __init__).
-            t_np = jax.tree.map(np.asarray, trees_k)
+            if tracing_enabled():
+                with global_tracer().scope("gbt.fetch_chunk"):
+                    t_np = jax.tree.map(np.asarray, trees_k)
+            else:
+                t_np = jax.tree.map(np.asarray, trees_k)
             k = t_np["leaf"].shape[0]
             fetched += k
+            prev_t = (self.last_chunk_times[-1][1]
+                      if self.last_chunk_times else 0.0)
             self.last_chunk_times.append((fetched, get_time() - t0))
+            if _metrics.enabled():
+                # per-round time from the arrival delta the fetch loop
+                # already measures — no extra device sync
+                m = gbt_metrics()
+                m["phase"].observe(
+                    (self.last_chunk_times[-1][1] - prev_t) / k,
+                    engine="incore", phase="round")
+                m["rounds"].inc(k, engine="incore")
+                m["trees"].inc(k, engine="incore")
             if chunk_callback is not None:
                 chunk_callback(*self.last_chunk_times[-1])
             self.trees.extend(
@@ -626,6 +646,7 @@ class HistGBT(_ExternalMemoryEngine):
         predict correctly on raw features later.
         """
         p = self.param
+        t_bin = get_time()
         X = np.ascontiguousarray(X, dtype=np.float32)
         y = np.ascontiguousarray(y, dtype=np.float32)
         n, F = X.shape
@@ -724,7 +745,7 @@ class HistGBT(_ExternalMemoryEngine):
             bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
             bins.delete()
             del bins
-        return {
+        out = {
             "bins_t": bins_t,
             "y_d": jax.device_put(y, row_sharding),
             "w_d": jax.device_put(mask, row_sharding),
@@ -732,6 +753,13 @@ class HistGBT(_ExternalMemoryEngine):
             "n_padded": n + n_pad,
             "n_features": F,
         }
+        if _metrics.enabled():
+            # wall time of the whole quantize+stage pass (cuts, binning,
+            # H2D) — dispatch-async tail included only as far as the
+            # device_put calls themselves block
+            gbt_metrics()["phase"].observe(get_time() - t_bin,
+                                           engine="incore", phase="bin")
+        return out
 
     def _init_margin_device(self, n_padded: int) -> jax.Array:
         """Base-score margins created ON device (an np.full + device_put
@@ -1078,7 +1106,7 @@ class HistGBT(_ExternalMemoryEngine):
             out_specs=(preds_spec, P()),
             check_vma=False,
         )
-        self._round_fn = jax.jit(mapped, donate_argnums=(3,))
+        self._round_fn = jax.jit(mapped, donate_argnums=donate_argnums(3))
         _ROUND_FN_CACHE[cache_key] = self._round_fn
         return self._round_fn
 
@@ -1110,6 +1138,7 @@ class HistGBT(_ExternalMemoryEngine):
             return np.zeros(self._margin_shape(0), np.float32)
         outs = []
         for lo in range(0, len(X), self._PREDICT_BATCH):
+            t_b = get_time()
             xb = X[lo:lo + self._PREDICT_BATCH]
             bins = self._bin_matrix(jnp.asarray(xb))
             margin = self._apply_trees(
@@ -1118,6 +1147,12 @@ class HistGBT(_ExternalMemoryEngine):
                          jnp.float32))
             outs.append(np.asarray(
                 margin if output_margin else self._obj.transform(margin)))
+            if _metrics.enabled():
+                # np.asarray above is a real fetch, so this wall delta
+                # covers bin + tree apply + D2H for the batch
+                gbt_metrics()["phase"].observe(get_time() - t_b,
+                                               engine="incore",
+                                               phase="predict")
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def predict(self, X: np.ndarray, output_margin: bool = False,

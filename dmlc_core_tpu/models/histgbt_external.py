@@ -18,6 +18,7 @@ host, minutes through a remote-compile tunnel).
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional
 
@@ -27,13 +28,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG
-from dmlc_core_tpu.base.timer import get_time
+from dmlc_core_tpu.base.timer import block_until_ready_time, get_time
 from dmlc_core_tpu.ops.histogram import build_histogram
 from dmlc_core_tpu.ops.quantile import apply_bins
 from dmlc_core_tpu.models.gbt_split import (_advance_node, _host_bin_requested,
                                             _host_bin_t, _leaf_sums,
-                                            _make_best_split, _maybe_l1)
+                                            _make_best_split, _maybe_l1,
+                                            gbt_metrics)
 
 __all__ = ["_ExternalMemoryEngine"]
 
@@ -504,6 +507,23 @@ class _ExternalMemoryEngine:
         pack_tree = partial(_ext_pack_tree, half=half)
         eval_loss = partial(_ext_eval_loss, obj=obj)
 
+        # Fine-grained hist-build / split-scan / leaf / apply timing:
+        # this engine's phases are SEPARATE dispatches (unlike the fused
+        # in-core round program), so block_until_ready_time can attribute
+        # wall time per phase.  Opt-in: blocking after every phase
+        # serializes host/device overlap, so production runs keep the
+        # cheap per-round aggregate only.
+        phases_on = (_metrics.enabled() and os.environ.get(
+            "DMLC_METRICS_GBT_PHASES", "0") == "1")
+
+        def timed_phase(phase, fn, *a, **kw):
+            if not phases_on:
+                return fn(*a, **kw)
+            out, dt = block_until_ready_time(fn, *a, **kw)
+            gbt_metrics()["phase"].observe(dt, engine="external",
+                                           phase=phase)
+            return out
+
         def grow_one_tree(col, feat_mask, g_d, h_d):
             """One level-wise tree; returns device (feats, thrs, gains,
             leaf) and the per-chunk leaf assignments — nothing fetched.
@@ -518,16 +538,17 @@ class _ExternalMemoryEngine:
             for level in range(depth):
                 hist = None
                 for c in range(n_chunks):
-                    node[c], ph = adv_hist_lvl(
-                        chunk_bins(c), node[c], g_d[c], h_d[c],
-                        feat, thr, level, col)
+                    node[c], ph = timed_phase(
+                        "hist", adv_hist_lvl, chunk_bins(c), node[c],
+                        g_d[c], h_d[c], feat, thr, level, col)
                     hist = ph if hist is None else hist + ph
                 if distributed:
                     hist = coll.allreduce_device(hist)
                 if level > 0:
                     hist = sib_stack(hist, prev_hist, level=level)
                 prev_hist = hist
-                feat, thr, gain = split_fn(hist, feat_mask)
+                feat, thr, gain = timed_phase("split", split_fn, hist,
+                                              feat_mask)
                 feats.append(feat)
                 thrs.append(thr)
                 gains.append(gain)
@@ -535,8 +556,9 @@ class _ExternalMemoryEngine:
             for c in range(n_chunks):
                 g_c = g_d[c] if col is None else g_d[c][:, col]
                 h_c = h_d[c] if col is None else h_d[c][:, col]
-                node[c], gs, hs = final_adv_leaf(
-                    chunk_bins(c), node[c], g_c, h_c, feat, thr)
+                node[c], gs, hs = timed_phase(
+                    "leaf", final_adv_leaf, chunk_bins(c), node[c],
+                    g_c, h_c, feat, thr)
                 gsum = gs if gsum is None else gsum + gs
                 hsum = hs if hsum is None else hsum + hs
             if distributed:
@@ -594,8 +616,9 @@ class _ExternalMemoryEngine:
                     unpack_tree(pack_tree(feats, thrs, gains, leaf))
                     return
                 for c in range(n_chunks):
-                    preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
-                                           col=None)
+                    preds_d[c] = timed_phase("apply", upd_preds,
+                                             preds_d[c], node[c], leaf,
+                                             col=None)
                 f, t, gn, lf = unpack_tree(pack_tree(feats, thrs, gains,
                                                      leaf))
                 self.trees.append({"feat": f, "thr": t, "gain": gn,
@@ -609,8 +632,9 @@ class _ExternalMemoryEngine:
                         unpack_tree(pack_tree(feats, thrs, gains, leaf))
                         continue
                     for c in range(n_chunks):
-                        preds_d[c] = upd_preds(preds_d[c], node[c], leaf,
-                                               col=col)
+                        preds_d[c] = timed_phase("apply", upd_preds,
+                                                 preds_d[c], node[c],
+                                                 leaf, col=col)
                     per_class.append(unpack_tree(
                         pack_tree(feats, thrs, gains, leaf)))
                 if not record:
@@ -629,10 +653,22 @@ class _ExternalMemoryEngine:
             # through a tunnel if left inside the timed region)
             one_round(0, record=False)
         warmup_s = get_time() - t_w
+        if _metrics.enabled() and warmup_rounds > 0:
+            gbt_metrics()["phase"].observe(warmup_s, engine="external",
+                                           phase="warmup")
 
         t0 = get_time()
         for r in range(p.n_trees):
+            t_r = get_time()
             one_round(r, record=True)
+            if _metrics.enabled():
+                # the per-tree unpack inside one_round already synced, so
+                # this wall delta is a true round time, no extra fetch
+                m = gbt_metrics()
+                m["phase"].observe(get_time() - t_r, engine="external",
+                                   phase="round")
+                m["rounds"].inc(1, engine="external")
+                m["trees"].inc(1, engine="external")
             if eval_every and (r + 1) % eval_every == 0:
                 # mean of per-row losses across all chunks (pad rows
                 # excluded by the static n_valid slice), then the

@@ -36,12 +36,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG
 from dmlc_core_tpu.base.parameter import get_env
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.models.gbt_objectives import (OBJECTIVES,
                                                  fold_scale_pos_weight)
-from dmlc_core_tpu.models.gbt_split import _maybe_l1
+from dmlc_core_tpu.models.gbt_split import _maybe_l1, gbt_metrics
 from dmlc_core_tpu.models.histgbt import HistGBTParam
 from dmlc_core_tpu.ops.sparse_hist import (SparseCuts, bin_sparse_entries,
                                            build_sparse_cuts, csr_rows,
@@ -339,6 +340,18 @@ class SparseHistGBT:
                      - self.cuts.bin_ptr[self.cuts.feat_of_bin])
         dense_pos_d = jnp.asarray(dense_pos)
         n_dense = F * b_max
+        # one wide feature pads EVERY narrow one: the split scan's
+        # per-level scatter buffer is O(nodes * n_dense) f32 — the
+        # dense-size blow-up this engine exists to avoid.  Same spirit
+        # as the distributed-cuts allgather warning above.
+        if n_dense > 16 * max(TB, 1):
+            LOG("WARNING", "SparseHistGBT: padded-dense split buffer has "
+                "%d slots for only %d real bins (widest feature: "
+                "b_max=%d bins) — one high-cardinality feature is "
+                "padding every narrow one; drop n_bins (wide sparse "
+                "features rarely need %d bins) or bin that feature "
+                "coarser via precomputed cuts=", n_dense, TB, b_max,
+                p.n_bins)
         y_d = jnp.asarray(y)
         w_d = (jnp.ones(n, jnp.float32) if weight is None
                else jnp.asarray(np.asarray(weight, np.float32)))
@@ -398,6 +411,12 @@ class SparseHistGBT:
                 unpack(np.asarray(flat_d))
         jax.block_until_ready(preds)
         self.last_fit_seconds = get_time() - t0
+        if _metrics.enabled() and p.n_trees:
+            m = gbt_metrics()
+            m["rounds"].inc(p.n_trees, engine="sparse")
+            m["trees"].inc(p.n_trees, engine="sparse")
+            m["phase"].observe(self.last_fit_seconds / p.n_trees,
+                               engine="sparse", phase="round")
         self._train_margin = preds
         return self
 

@@ -34,7 +34,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dmlc_core_tpu.base.compat import donate_argnums, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ
@@ -69,7 +69,7 @@ def _slab_write_impl(buf, slab, lo):
     return jax.lax.dynamic_update_slice(buf, slab, (lo, 0))
 
 
-_slab_write = jax.jit(_slab_write_impl, donate_argnums=(0,))
+_slab_write = jax.jit(_slab_write_impl, donate_argnums=donate_argnums(0))
 
 
 class GBLinearParam(Parameter):

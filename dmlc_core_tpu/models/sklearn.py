@@ -191,8 +191,11 @@ class _EstimatorBase(_SkBase):
         indptr, indices, data, _ = self._csr_canon(X)
         return self._model.predict(indptr, indices, data, **kw)
 
-    def _raw_margin(self, X):
-        """Booster-raw predictions with SYMMETRIC input-type guards:
+    def _predict_native(self, X):
+        """TRANSFORMED native-booster predictions (sigmoid probabilities
+        for binary:logistic, values for regression — NOT raw margins:
+        both paths run the objective's output transform), with
+        SYMMETRIC input-type guards:
         a sparse-fit model requires sparse X (dense zeros would mean
         VALUES, not absence) and a dense-fit model requires dense X
         (np.asarray on a scipy matrix dies with an unrelated
@@ -332,7 +335,7 @@ class GBTClassifier(_SkClf, _EstimatorBase):
         return self
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        raw = self._raw_margin(X)
+        raw = self._predict_native(X)
         if len(self.classes_) == 2:
             return self.classes_[(np.asarray(raw) > 0.5).astype(int)]
         return self.classes_[np.asarray(raw).astype(int)]
@@ -342,7 +345,7 @@ class GBTClassifier(_SkClf, _EstimatorBase):
 
         if self.booster == "gblinear" or isinstance(self.model,
                                                     SparseHistGBT):
-            p1 = np.asarray(self._raw_margin(X))
+            p1 = np.asarray(self._predict_native(X))
             return np.stack([1.0 - p1, p1], axis=1)
         return self.model.predict_proba(X)
 
@@ -368,7 +371,7 @@ class GBTRegressor(_SkReg, _EstimatorBase):
         return self
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return np.asarray(self._raw_margin(X))
+        return np.asarray(self._predict_native(X))
 
     def score(self, X: np.ndarray, y: np.ndarray) -> float:
         """R² (sklearn regressor convention)."""

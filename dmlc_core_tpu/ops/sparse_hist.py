@@ -334,12 +334,16 @@ def sparse_best_split(hist, totals, bin_ptr_d, feat_of_bin_d, last_mask,
     feat = feat_of_bin_d[best]
     thr = (best - bin_ptr_d[feat]).astype(jnp.int32)
     dirv = jnp.take_along_axis(dir_l, best[:, None], axis=1)[:, 0]
-    ok = best_gain > gamma
+    # XGBoost convention, matching the dense chooser (gbt_split.py): the
+    # acceptance test and the reported gain both carry the ½ factor —
+    # the same `gamma` must mean the same thing whichever engine the
+    # sklearn wrappers route to, and importance_type="gain" must agree
+    ok = 0.5 * best_gain > gamma
     width0 = (bin_ptr_d[1] - bin_ptr_d[0]).astype(jnp.int32)
     feat = jnp.where(ok, feat, 0).astype(jnp.int32)
     thr = jnp.where(ok, thr, width0 - 1)
     dirv = jnp.where(ok, dirv, True)
-    gain_out = jnp.where(ok, best_gain, 0.0)
+    gain_out = jnp.where(ok, 0.5 * best_gain, 0.0)
     return feat, thr, dirv, gain_out
 
 

@@ -26,6 +26,7 @@ parity with the reference's coordination brain.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
@@ -35,9 +36,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from dmlc_core_tpu.base.compat import shard_map
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
+from dmlc_core_tpu.base.timer import get_time
+from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
 __all__ = [
     "init", "finalize", "rank", "world_size", "is_distributed",
@@ -57,6 +61,53 @@ _REDUCERS = {
     "prod": np.multiply.reduce,
     "bitor": np.bitwise_or.reduce,
 }
+
+_CM = None
+
+
+def _coll_metrics():
+    global _CM
+    if _CM is None:
+        r = _metrics.default_registry()
+        _CM = {
+            "calls": r.counter("collective_calls_total",
+                               "collective invocations", labels=("op",)),
+            "bytes": r.counter("collective_bytes_total",
+                               "payload bytes entering collectives",
+                               labels=("op",)),
+            "seconds": r.histogram("collective_seconds",
+                                   "host-path collective latency",
+                                   labels=("op",)),
+        }
+    return _CM
+
+
+@contextlib.contextmanager
+def _host_op_span(op: str, nbytes: int):
+    """Metrics + trace span around one host-path collective.
+
+    The host collectives run BETWEEN steps, so their wall time is real
+    blocked-training time — worth a latency histogram (the in-jit device
+    collectives dispatch async and are timed by the device profiler, not
+    here).  Fast-exits to a bare yield when both sinks are off.
+    """
+    collect = _metrics.enabled()
+    if not collect and not tracing_enabled():
+        yield
+        return
+    ctx = (global_tracer().scope(f"collective.{op}", bytes=int(nbytes))
+           if tracing_enabled() else contextlib.nullcontext())
+    t0 = get_time()
+    try:
+        with ctx:
+            yield
+    finally:
+        if collect:
+            m = _coll_metrics()
+            m["calls"].inc(1, op=op)
+            if nbytes:
+                m["bytes"].inc(nbytes, op=op)
+            m["seconds"].observe(get_time() - t0, op=op)
 
 
 # ---------------------------------------------------------------------------
@@ -142,40 +193,44 @@ def allreduce(x: np.ndarray, op: str = "sum") -> np.ndarray:
     x = np.asarray(x)
     if op not in _REDUCERS:
         log_fatal(f"allreduce: unknown op {op!r}; valid: {sorted(_REDUCERS)}")
-    if world_size() == 1:
-        return x
-    from jax.experimental import multihost_utils
+    with _host_op_span("allreduce", x.nbytes):
+        if world_size() == 1:
+            return x
+        from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(x, tiled=False)  # [world, ...]
-    return _REDUCERS[op](np.asarray(gathered), axis=0)
+        gathered = multihost_utils.process_allgather(x, tiled=False)  # [world, ...]
+        return _REDUCERS[op](np.asarray(gathered), axis=0)
 
 
 def broadcast(x: Any, root: int = 0) -> Any:
     """Broadcast a host value from ``root``.  Reference: rabit ``Broadcast``."""
-    if world_size() == 1:
-        return x
-    from jax.experimental import multihost_utils
+    with _host_op_span("broadcast", getattr(x, "nbytes", 0)):
+        if world_size() == 1:
+            return x
+        from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(x, is_source=rank() == root)
+        return multihost_utils.broadcast_one_to_all(x, is_source=rank() == root)
 
 
 def allgather(x: np.ndarray) -> np.ndarray:
     """Gather arrays from all processes, stacked on axis 0 in rank order."""
     x = np.asarray(x)
-    if world_size() == 1:
-        return x[None]
-    from jax.experimental import multihost_utils
+    with _host_op_span("allgather", x.nbytes):
+        if world_size() == 1:
+            return x[None]
+        from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=False))
+        return np.asarray(multihost_utils.process_allgather(x, tiled=False))
 
 
 def barrier(name: str = "dmlc") -> None:
     """Cross-process barrier (rabit's implicit sync points, made explicit)."""
-    if world_size() == 1:
-        return
-    from jax.experimental import multihost_utils
+    with _host_op_span("barrier", 0):
+        if world_size() == 1:
+            return
+        from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+        multihost_utils.sync_global_devices(name)
 
 
 @lru_cache(maxsize=None)
@@ -206,6 +261,12 @@ def allreduce_device(x: jax.Array) -> jax.Array:
     """
     if world_size() == 1:
         return x
+    if _metrics.enabled():
+        # calls + bytes only: the result is returned un-synced, so wall
+        # time here would measure dispatch, not the collective
+        m = _coll_metrics()
+        m["calls"].inc(1, op="allreduce_device")
+        m["bytes"].inc(getattr(x, "nbytes", 0), op="allreduce_device")
     mesh = _world_mesh()
     locals_ = jax.local_devices()
     x = jnp.asarray(x)
@@ -314,7 +375,7 @@ def _jitted_reduce_scatter(mesh: Mesh, axis: str, op: str):
             return jax.lax.psum_scatter(full, axis, tiled=True)
         # max/min have no fused scatter primitive: reduce then slice
         red = (jax.lax.pmax if op == "max" else jax.lax.pmin)(full, axis)
-        k = jax.lax.axis_size(axis)
+        k = mesh.shape[axis]          # static (lax.axis_size is newer jax)
         i = jax.lax.axis_index(axis)
         piece = full.shape[0] // k
         return jax.lax.dynamic_slice_in_dim(red, i * piece, piece, axis=0)

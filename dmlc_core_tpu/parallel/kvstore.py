@@ -50,7 +50,7 @@ def _fused_mesh_reducer(mesh, axis):
     within it jax.jit caches per bucket composition (shapes tuple)."""
     from functools import partial
 
-    from jax import shard_map
+    from dmlc_core_tpu.base.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @jax.jit

@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from dmlc_core_tpu.base.compat import axis_size
 
 __all__ = ["moe_ffn", "reference_moe_ffn"]
 
@@ -62,7 +63,7 @@ def moe_ffn(
     """
     T, D = x.shape
     E = wr.shape[1]
-    P = lax.axis_size(axis) if axis is not None else 1
+    P = axis_size(axis) if axis is not None else 1
     e_local = w1.shape[0]
     cap = max(1, int(np.ceil(capacity_factor * T / E)))
 

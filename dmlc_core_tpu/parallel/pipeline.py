@@ -37,7 +37,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from dmlc_core_tpu.base.compat import axis_size, donate_argnums, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ
@@ -66,7 +67,7 @@ def _rlb_fwd(x, axis):
 
 
 def _rlb_bwd(axis, _res, ct):
-    return (ct / lax.axis_size(axis),)
+    return (ct / axis_size(axis),)
 
 
 _replicated_loss_boundary.defvjp(_rlb_fwd, _rlb_bwd)
@@ -89,7 +90,7 @@ def pipeline_apply(
     ticks).  Differentiable end-to-end: reverse-mode AD through the scan
     emits the reverse ppermutes of the backward pipeline.
     """
-    S = lax.axis_size(axis)
+    S = axis_size(axis)
     idx = lax.axis_index(axis)
     M = x_micro.shape[0]
     mb_shape = x_micro.shape[1:]
@@ -287,7 +288,7 @@ class PipelineLM:
             step, mesh=self.mesh, in_specs=in_specs,
             out_specs=({k: specs[k] for k in specs}, P()),
             check_vma=False)
-        self._step_fn = jax.jit(mapped, donate_argnums=(0,))
+        self._step_fn = jax.jit(mapped, donate_argnums=donate_argnums(0))
 
         # scan-chunked multi-step program (fit_chunked): K steps per
         # dispatch, same rationale as BERT.fit_chunked — a per-step host
@@ -306,7 +307,7 @@ class PipelineLM:
                     multi, mesh=self.mesh, in_specs=in_specs,
                     out_specs=({k: specs[k] for k in specs}, P()),
                     check_vma=False)
-                self._multi_cache[K] = jax.jit(mapped_k, donate_argnums=(0,))
+                self._multi_cache[K] = jax.jit(mapped_k, donate_argnums=donate_argnums(0))
             return self._multi_cache[K]
 
         self._make_multi = make_multi

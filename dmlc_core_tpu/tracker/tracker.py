@@ -21,10 +21,39 @@ import socket
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
 from dmlc_core_tpu.parallel.collectives import get_link_map
+from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
 __all__ = ["RabitTracker", "WorkerSession", "PSTracker", "submit"]
+
+_TM = None
+
+
+def _tracker_metrics():
+    global _TM
+    if _TM is None:
+        r = _metrics.default_registry()
+        _TM = {
+            "connections": r.gauge("tracker_connections",
+                                   "worker connections currently served"),
+            "alive": r.gauge("tracker_workers_alive",
+                             "ranks with a live persistent connection"),
+            "events": r.counter("tracker_worker_events_total",
+                                "worker lifecycle events",
+                                labels=("event",)),
+        }
+    return _TM
+
+
+def _worker_event(event: str, rank: int = -1) -> None:
+    """One lifecycle event → counter + (when tracing) a trace instant —
+    the worker-churn timeline the reference's tracker only logged."""
+    if _metrics.enabled():
+        _tracker_metrics()["events"].inc(1, event=event)
+    if tracing_enabled():
+        global_tracer().instant(f"tracker.{event}", rank=rank)
 
 
 class RabitTracker:
@@ -88,6 +117,8 @@ class RabitTracker:
         rank for a replacement worker (``start`` reuses freed ranks).
         """
         state: Dict[str, Any] = {"rank": -1, "persistent": False, "clean": False}
+        if _metrics.enabled():
+            _tracker_metrics()["connections"].inc(1)
         try:
             with conn:
                 buf = b""
@@ -113,6 +144,8 @@ class RabitTracker:
             pass
         finally:
             self._on_disconnect(state)
+            if _metrics.enabled():
+                _tracker_metrics()["connections"].dec(1)
 
     def _on_disconnect(self, state: Dict[str, Any]) -> None:
         rank = state["rank"]
@@ -126,9 +159,12 @@ class RabitTracker:
             if self._alive.get(rank) is not state.get("conn"):
                 return
             del self._alive[rank]
+            if _metrics.enabled():
+                _tracker_metrics()["alive"].set(len(self._alive))
             if not state["clean"]:
                 self.dead_workers.append(rank)
                 self._free_ranks.append(rank)
+                _worker_event("death", rank)
                 LOG("WARNING", "tracker: worker rank %d died (socket closed "
                     "without shutdown); rank freed for recovery", rank)
 
@@ -147,6 +183,7 @@ class RabitTracker:
             return None
         if cmd == "shutdown":
             state["clean"] = True
+            _worker_event("shutdown", state["rank"])
             with self._lock:
                 self._shutdown_count += 1
                 if self._shutdown_count >= self.nworker:
@@ -175,8 +212,11 @@ class RabitTracker:
                     state["rank"], state["persistent"] = rank, True
                     state["conn"] = conn
                     self._alive[rank] = conn
+                    if _metrics.enabled():
+                        _tracker_metrics()["alive"].set(len(self._alive))
             if rank >= self.nworker:
                 return {"error": f"too many workers (nworker={self.nworker})"}
+            _worker_event(cmd, rank)
             link = self._links[rank]
             return {
                 "rank": rank,

@@ -6,5 +6,7 @@ from dmlc_core_tpu.utils.profiler import (  # noqa: F401
     annotate,
     device_trace,
     global_tracer,
+    set_tracing,
     step_annotation,
+    tracing_enabled,
 )

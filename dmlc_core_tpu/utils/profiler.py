@@ -19,6 +19,15 @@ module provides
 
 All host events go through ``base.timer.get_time`` so Tracer timestamps
 line up with the rest of the framework's timing.
+
+Hot-path integration (PR: observability substrate): the instrumented
+pipelines (ThreadedIter, parsers, collectives, the GBT engines) emit
+scopes/instants to :func:`global_tracer` ONLY while host tracing is
+switched on (:func:`set_tracing` / ``DMLC_TRACE=1``) — tracing is
+event-per-item and unbounded-ish in volume, so unlike the aggregate
+metrics layer (``base.metrics``) it defaults OFF.  The Tracer buffer is
+capped (``max_events``) so a scope left enabled for a long run degrades
+to dropped events, never to unbounded host memory.
 """
 
 from __future__ import annotations
@@ -32,7 +41,23 @@ from typing import Any, Dict, Iterator, List, Optional
 from dmlc_core_tpu.base.timer import get_time
 
 __all__ = ["device_trace", "annotate", "step_annotation", "Tracer",
-           "global_tracer"]
+           "global_tracer", "tracing_enabled", "set_tracing"]
+
+_TRACING = os.environ.get("DMLC_TRACE", "0").lower() in ("1", "true", "on",
+                                                         "yes")
+
+
+def tracing_enabled() -> bool:
+    """Fast global switch read by hot-path call sites before they touch
+    :func:`global_tracer` — one global read + branch when off."""
+    return _TRACING
+
+
+def set_tracing(on: bool) -> None:
+    """Enable/disable host-event tracing process-wide (also:
+    ``DMLC_TRACE=1``)."""
+    global _TRACING
+    _TRACING = bool(on)
 
 
 @contextlib.contextmanager
@@ -97,15 +122,27 @@ class Tracer:
 
     Thread-safe; events carry real thread ids so producer/consumer
     overlap (the ThreadedIter pipeline) is visible on separate rows.
+    The buffer is bounded: past ``max_events`` new events are dropped
+    (and counted — ``dropped`` rides into the saved trace's metadata)
+    rather than growing host memory without limit.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, max_events: int = 200_000) -> None:
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._t0 = get_time()
+        self._max_events = max_events
+        self.dropped = 0
 
     def _us(self) -> float:
         return (get_time() - self._t0) * 1e6
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
 
     @contextlib.contextmanager
     def scope(self, name: str, **args: Any) -> Iterator[None]:
@@ -115,28 +152,25 @@ class Tracer:
             yield
         finally:
             end = self._us()
-            with self._lock:
-                self._events.append({
-                    "name": name, "ph": "X", "ts": start,
-                    "dur": end - start, "pid": os.getpid(),
-                    "tid": threading.get_ident(),
-                    "args": args or {},
-                })
-
-    def instant(self, name: str, **args: Any) -> None:
-        with self._lock:
-            self._events.append({
-                "name": name, "ph": "i", "ts": self._us(), "s": "t",
-                "pid": os.getpid(), "tid": threading.get_ident(),
+            self._append({
+                "name": name, "ph": "X", "ts": start,
+                "dur": end - start, "pid": os.getpid(),
+                "tid": threading.get_ident(),
                 "args": args or {},
             })
 
+    def instant(self, name: str, **args: Any) -> None:
+        self._append({
+            "name": name, "ph": "i", "ts": self._us(), "s": "t",
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": args or {},
+        })
+
     def counter(self, name: str, value: float, series: str = "value") -> None:
-        with self._lock:
-            self._events.append({
-                "name": name, "ph": "C", "ts": self._us(),
-                "pid": os.getpid(), "args": {series: value},
-            })
+        self._append({
+            "name": name, "ph": "C", "ts": self._us(),
+            "pid": os.getpid(), "args": {series: value},
+        })
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -145,11 +179,14 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self.dropped = 0
 
     def save(self, path: str) -> str:
         with self._lock:
-            payload = {"traceEvents": list(self._events),
-                       "displayTimeUnit": "ms"}
+            payload: Dict[str, Any] = {"traceEvents": list(self._events),
+                                       "displayTimeUnit": "ms"}
+            if self.dropped:
+                payload["otherData"] = {"dropped_events": self.dropped}
         d = os.path.dirname(os.path.abspath(path))
         if d:
             os.makedirs(d, exist_ok=True)

@@ -9,7 +9,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dmlc_core_tpu.base.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.models.histgbt import _make_best_split

@@ -11,7 +11,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dmlc_core_tpu.base.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_core_tpu.models.bert import BERT
@@ -236,7 +236,7 @@ class TestUlysses:
     def test_matches_full_softmax(self, causal, rng):
         from functools import partial
 
-        from jax import shard_map
+        from dmlc_core_tpu.base.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dmlc_core_tpu.parallel.mesh import MeshSpec, create_mesh
@@ -263,7 +263,7 @@ class TestUlysses:
     def test_head_divisibility_rejected(self, rng):
         from functools import partial
 
-        from jax import shard_map
+        from dmlc_core_tpu.base.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dmlc_core_tpu.parallel.mesh import MeshSpec, create_mesh
@@ -285,7 +285,7 @@ class TestUlysses:
         """Both SP formulations must agree on the same sharded inputs."""
         from functools import partial
 
-        from jax import shard_map
+        from dmlc_core_tpu.base.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dmlc_core_tpu.parallel.mesh import MeshSpec, create_mesh

@@ -981,7 +981,7 @@ class TestZeroAdam:
         replicated Adam on the globally-summed gradients."""
 
         import jax
-        from jax import shard_map
+        from dmlc_core_tpu.base.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dmlc_core_tpu.parallel.mesh import local_mesh
@@ -1028,7 +1028,7 @@ class TestZeroAdam:
 
     def test_state_is_sharded(self):
         import jax
-        from jax import shard_map
+        from dmlc_core_tpu.base.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dmlc_core_tpu.parallel.mesh import local_mesh
@@ -1051,7 +1051,7 @@ class TestZeroAdam:
 
     def test_nested_pytree_params(self):
         import jax
-        from jax import shard_map
+        from dmlc_core_tpu.base.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from dmlc_core_tpu.parallel.mesh import local_mesh
