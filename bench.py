@@ -450,6 +450,174 @@ def _smoke_kvstore(mesh):
     return round((time.perf_counter() - t0) / steps * 1e3, 2)
 
 
+def latency_summary(lats_s):
+    """p50/p95/p99/mean (ms) of a latency sample list — pure, so the
+    serve-bench percentile math is unit-testable (tests/test_serve.py)."""
+    if not lats_s:
+        return {"latency_p50_ms": None, "latency_p95_ms": None,
+                "latency_p99_ms": None, "latency_mean_ms": None}
+    s = sorted(lats_s)
+
+    def q(p):
+        return s[min(len(s) - 1, max(0, int(round(p * (len(s) - 1)))))]
+
+    return {
+        "latency_p50_ms": round(q(0.50) * 1e3, 3),
+        "latency_p95_ms": round(q(0.95) * 1e3, 3),
+        "latency_p99_ms": round(q(0.99) * 1e3, 3),
+        "latency_mean_ms": round(sum(s) / len(s) * 1e3, 3),
+    }
+
+
+def _serve_emit(rec, final=False):
+    rec = {"metric": "serve_requests_per_sec", "unit": "req/s",
+           "provisional": not final, **rec}
+    if final:
+        _attach_metrics(rec)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(rec) + "\n")
+        sys.stdout.flush()
+
+
+def _serve_bench() -> None:
+    """``--serve``: open-loop load over the serve batcher+runner.
+
+    Trains a small GBT, publishes it to a ModelRegistry, then drives the
+    DynamicBatcher directly (no HTTP — the socket layer has its own soak
+    test) with Poisson arrivals at ``SERVE_QPS`` for ``SERVE_SECONDS``,
+    request sizes drawn from ``SERVE_REQ_SIZES`` (comma list, sampled
+    uniformly — repeat a size to weight it).  Emits the same JSON shape
+    as the GBT bench: one provisional line per phase, a final line with
+    throughput, latency percentiles, reject counts and a batch-size
+    histogram summary; ``--metrics-out`` archives the full registry
+    snapshot.  All buckets are warmed before the timed window so jit
+    compiles don't pollute the latency sample."""
+    t0 = time.time()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
+    qps = float(os.environ.get("SERVE_QPS", 300))
+    duration = min(float(os.environ.get("SERVE_SECONDS", 10)),
+                   max(budget - 120, 2.0))
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", 256))
+    max_delay = float(os.environ.get("SERVE_MAX_DELAY_MS", 2.0)) / 1e3
+    sizes = [int(s) for s in
+             os.environ.get("SERVE_REQ_SIZES", "1,1,1,1,2,4,8,16").split(",")]
+    train_rows = int(os.environ.get("SERVE_TRAIN_ROWS", 50_000))
+    n_trees = int(os.environ.get("SERVE_TREES", 20))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dmlc_core_tpu.utils import force_cpu_devices
+        force_cpu_devices(int(os.environ["BENCH_FORCE_CPU"]))
+
+    cfg = {"qps": qps, "duration_s": duration, "max_batch": max_batch,
+           "max_delay_ms": max_delay * 1e3, "req_sizes": sizes,
+           "train_rows": train_rows, "n_trees": n_trees}
+    _serve_emit({"value": 0.0, "phase": "train", **cfg})
+
+    import jax  # noqa: F401 — device init before timing anything
+
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.serve import DynamicBatcher, ModelRegistry
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(train_rows, feats)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
+    model = HistGBT(n_trees=n_trees, max_depth=4, n_bins=64,
+                    learning_rate=0.3)
+    model.fit(X, y)
+
+    registry = ModelRegistry(max_batch=max_batch, min_bucket=8)
+    registry.publish(model, source="serve-bench")
+    _, runner = registry.current()
+
+    def execute(batch):
+        version, r = registry.current()
+        return r.predict(batch), version
+
+    _serve_emit({"value": 0.0, "phase": "warmup", **cfg})
+    b = runner.min_bucket
+    while b <= max_batch:                    # compile every ladder bucket
+        runner.predict(np.zeros((b, feats), np.float32))
+        b <<= 1
+
+    batcher = DynamicBatcher(execute, max_batch=max_batch,
+                             max_delay=max_delay, max_queue=512,
+                             name="serve-bench")
+    lats = []
+    errors = [0]
+    lock = threading.Lock()
+
+    def record(fut, t_sub):
+        try:
+            fut.result()
+        except Exception:  # noqa: BLE001
+            with lock:
+                errors[0] += 1
+            return
+        with lock:
+            lats.append(time.perf_counter() - t_sub)
+
+    from dmlc_core_tpu.serve import QueueFullError
+
+    _serve_emit({"value": 0.0, "phase": "load", **cfg})
+    submitted = rejected = 0
+    start = time.perf_counter()
+    next_t = start
+    end = start + duration
+    while (now := time.perf_counter()) < end:
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        next_t += rng.exponential(1.0 / qps)
+        k = int(rng.choice(sizes))
+        lo = int(rng.integers(0, train_rows - k))
+        t_sub = time.perf_counter()
+        try:
+            fut = batcher.submit(X[lo:lo + k], timeout=5.0)
+        except QueueFullError:
+            rejected += 1
+            continue
+        fut.add_done_callback(lambda f, t=t_sub: record(f, t))
+        submitted += 1
+    batcher.close(drain=True)
+    wall = time.perf_counter() - start
+
+    # batch-size evidence straight from the serve instruments
+    batch_summary = {}
+    try:
+        from dmlc_core_tpu.base.metrics import default_registry
+        snap = default_registry().snapshot()["metrics"]
+        hs = snap.get("dmlc_serve_batch_rows", {}).get("series", [])
+        se = next((s for s in hs
+                   if s["labels"].get("batcher") == "serve-bench"), None)
+        if se:
+            batch_summary = {
+                "batches": se["count"],
+                "batch_rows_p50": se["quantiles"]["p50"],
+                "batch_rows_p99": se["quantiles"]["p99"],
+                "batch_rows_max": se["max"],
+            }
+    except Exception:  # noqa: BLE001 — evidence, not the headline
+        pass
+
+    done = len(lats)
+    _serve_emit({
+        "value": round(done / wall, 2) if wall > 0 else 0.0,
+        "phase": "done",
+        "elapsed_s": round(time.time() - t0, 1),
+        "platform": jax.devices()[0].platform,
+        "submitted": submitted,
+        "completed": done,
+        "rejected": rejected,
+        "errors": errors[0],
+        **latency_summary(lats),
+        **batch_summary,
+        "compiled_shapes": sorted(runner.compiled_shapes),
+        "shape_bound": runner.shape_bound,
+        **cfg,
+    }, final=True)
+
+
 def main() -> None:
     EV["t0"] = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
@@ -653,4 +821,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv:
+        _serve_bench()
+    else:
+        main()

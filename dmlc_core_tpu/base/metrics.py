@@ -89,7 +89,18 @@ def _label_key(names: Tuple[str, ...], labels: Dict[str, Any]) -> Tuple[str, ...
 
 
 def _escape_label(value: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double-quote and newline (in that order — backslash first so the
+    escapes themselves don't get re-escaped).  Model names and
+    checkpoint URIs become label values on the serving ``/metrics``
+    endpoint, so hostile values are a live concern, not a formality."""
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-text escaping: the exposition format escapes backslash and
+    newline there (quotes are legal raw in HELP, unlike label values)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(v: float) -> str:
@@ -406,7 +417,7 @@ class MetricsRegistry:
         lines: List[str] = []
         for m in sorted(self.metrics(), key=lambda m: m.name):
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m._export())
         return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,272 @@
+"""Stdlib-sockets HTTP/JSON serving frontend.
+
+Same socket idioms as ``tracker/tracker.py``'s RabitTracker: bind an
+ephemeral TCP port, a daemon accept-loop thread with a short accept
+timeout (so shutdown is prompt), one daemon thread per connection.  The
+protocol is minimal HTTP/1.1 (one request per connection,
+``Connection: close``) because the payloads are small JSON bodies and
+the hard problems — batching, admission control, hot-swap — live behind
+the socket, not in it.
+
+Routes:
+
+* ``POST /predict`` — body ``{"rows": [[...], ...]}`` (one request may
+  carry several rows).  Rows are submitted to the shared
+  :class:`~dmlc_core_tpu.serve.batcher.DynamicBatcher`; the response is
+  ``{"predictions": [...], "version": v}`` where ``v`` is the model
+  version that executed the batch.  A full queue answers **503**
+  immediately (admission control with ``Retry-After``), an expired
+  request **504**, a malformed body **400**.
+* ``GET /healthz`` — liveness + current model version + queue depth.
+* ``GET /metrics`` — Prometheus text exposition of the process-wide
+  registry (``base.metrics.default_registry``): every serve instrument
+  plus whatever training/io metrics the process has recorded.
+
+Instrumentation per request: ``serve_requests_total{path, code}``,
+end-to-end latency ``serve_request_seconds{path}``, and on success the
+per-model-version counter ``serve_version_requests_total{version}``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.timer import get_time
+from dmlc_core_tpu.serve.batcher import (BatcherClosedError, DynamicBatcher,
+                                         QueueFullError)
+from dmlc_core_tpu.serve.instruments import serve_metrics
+from dmlc_core_tpu.serve.registry import ModelRegistry
+
+__all__ = ["ServeFrontend"]
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 408: "Request Timeout",
+            500: "Internal Server Error", 503: "Service Unavailable",
+            504: "Gateway Timeout"}
+
+#: request-body cap — a predict batch of max_batch × a few thousand
+#: features in JSON stays far below this; anything bigger is abuse
+_MAX_BODY = 64 << 20
+
+
+class ServeFrontend:
+    """HTTP face of a :class:`ModelRegistry` + :class:`DynamicBatcher`.
+
+    The frontend owns the batcher; its execute hook resolves
+    ``registry.current()`` ONCE per batch, so a hot-swap lands between
+    batches and in-flight work finishes on the version it started on.
+    """
+
+    def __init__(self, registry: ModelRegistry,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 1024, max_delay: float = 0.002,
+                 max_queue: int = 256, request_timeout: float = 30.0):
+        self.registry = registry
+        self.request_timeout = request_timeout
+        self._batcher = DynamicBatcher(
+            self._execute, max_batch=max_batch, max_delay=max_delay,
+            max_queue=max_queue, name=registry.name)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        """Base URL clients should hit (host:port resolved at bind)."""
+        return f"http://{self.host}:{self.port}"
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServeFrontend":
+        """Begin accepting connections (idempotent)."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._accept_loop, daemon=True,
+                name=f"serve-frontend-{self.registry.name}")
+            self._thread.start()
+            LOG("INFO", "serve.frontend %s: listening on %s",
+                self.registry.name, self.url)
+        return self
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting, then drain (or abort) the batcher."""
+        self._done.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self._batcher.close(drain=drain)
+
+    def __enter__(self) -> "ServeFrontend":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- batch execution -------------------------------------------------
+    def _execute(self, X: np.ndarray):
+        version, runner = self.registry.current()
+        return runner.predict(X), version
+
+    # -- socket plumbing (tracker.py idioms) -----------------------------
+    def _accept_loop(self) -> None:
+        while not self._done.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        t0 = get_time()
+        path = "?"
+        code = 500
+        try:
+            parsed = self._read_request(conn)
+            if parsed is None:
+                return
+            method, path, body = parsed
+            code, payload, ctype, headers = self._route(method, path, body)
+            self._respond(conn, code, payload, ctype, headers)
+        except Exception:  # noqa: BLE001 — client went away / raw-socket
+            pass           # garbage: nothing useful to answer
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if _metrics.enabled() and path != "?":
+                # clamp unknown paths to one label value — client-chosen
+                # URLs must not mint unbounded metric series
+                p = (path if path in ("/predict", "/healthz", "/metrics")
+                     else "other")
+                m = serve_metrics()
+                m["requests"].inc(1, path=p, code=str(code))
+                m["e2e"].observe(get_time() - t0, path=p)
+
+    @staticmethod
+    def _read_request(conn: socket.socket
+                      ) -> Optional[Tuple[str, str, bytes]]:
+        conn.settimeout(10.0)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            data += chunk
+            CHECK(len(data) < _MAX_BODY, "request headers too large")
+        head, _, body = data.partition(b"\r\n\r\n")
+        lines = head.decode("latin-1").split("\r\n")
+        parts = lines[0].split(" ")
+        CHECK(len(parts) >= 2, f"malformed request line {lines[0]!r}")
+        method, target = parts[0].upper(), parts[1]
+        headers = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", "0"))
+        CHECK(0 <= length < _MAX_BODY, f"bad content-length {length}")
+        while len(body) < length:
+            chunk = conn.recv(min(65536, length - len(body)))
+            if not chunk:
+                break
+            body += chunk
+        return method, target.split("?", 1)[0], body
+
+    @staticmethod
+    def _respond(conn: socket.socket, code: int, payload: Any,
+                 ctype: str, headers: Dict[str, str]) -> None:
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        extra = "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+        head = (f"HTTP/1.1 {code} {_REASONS.get(code, 'Unknown')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"{extra}Connection: close\r\n\r\n")
+        conn.sendall(head.encode("latin-1") + body)
+
+    # -- routing ---------------------------------------------------------
+    def _route(self, method: str, path: str, body: bytes
+               ) -> Tuple[int, Any, str, Dict[str, str]]:
+        if path == "/predict":
+            if method != "POST":
+                return (405, {"error": "POST only"},
+                        "application/json", {})
+            return self._handle_predict(body)
+        if path == "/healthz":
+            return 200, self._health(), "application/json", {}
+        if path == "/metrics":
+            text = _metrics.default_registry().to_prometheus()
+            return (200, text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8", {})
+        return 404, {"error": f"no route {path}"}, "application/json", {}
+
+    def _health(self) -> Dict[str, Any]:
+        version = self.registry.current_version()
+        out = {"status": "ok" if version is not None else "no_model",
+               "version": version,
+               "queue_depth": self._batcher.depth()}
+        if version is not None:
+            runner = self.registry.get(version)
+            out["batch_buckets"] = sorted(runner.compiled_shapes)
+        return out
+
+    def _handle_predict(self, body: bytes
+                        ) -> Tuple[int, Any, str, Dict[str, str]]:
+        if self.registry.current_version() is None:
+            return (503, {"error": "no model published"},
+                    "application/json", {"Retry-After": "1"})
+        try:
+            payload = json.loads(body)
+            rows = np.asarray(payload["rows"], np.float32)
+            if rows.ndim == 1:
+                rows = rows[None, :]
+            if rows.ndim != 2 or len(rows) == 0:
+                raise ValueError(f"bad rows shape {rows.shape}")
+            if len(rows) > self._batcher.max_batch:
+                raise ValueError(
+                    f"too many rows in one request: {len(rows)} > "
+                    f"max_batch {self._batcher.max_batch}")
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            return (400, {"error": f"bad request: {e}"},
+                    "application/json", {})
+        try:
+            fut = self._batcher.submit(rows, timeout=self.request_timeout)
+            preds, version = fut.result(timeout=self.request_timeout + 5.0)
+        except QueueFullError:
+            return (503, {"error": "queue full"},
+                    "application/json", {"Retry-After": "1"})
+        except BatcherClosedError:
+            return (503, {"error": "shutting down"},
+                    "application/json", {})
+        except TimeoutError:
+            return (504, {"error": "request timed out"},
+                    "application/json", {})
+        except Exception as e:  # noqa: BLE001 — model failure != crash
+            return (500, {"error": f"{type(e).__name__}: {e}"},
+                    "application/json", {})
+        if _metrics.enabled():
+            serve_metrics()["version_requests"].inc(
+                1, version=str(version))
+        return (200, {"predictions": np.asarray(preds).tolist(),
+                      "version": version},
+                "application/json", {})

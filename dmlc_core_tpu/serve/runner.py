@@ -1,0 +1,166 @@
+"""Padded-batch model executor with power-of-two shape buckets.
+
+The serving problem XLA creates: every distinct input shape is a fresh
+compilation (seconds each), and live traffic produces arbitrary batch
+sizes.  :class:`ModelRunner` routes any request batch into a small fixed
+ladder of power-of-two row buckets — the batch is zero-padded up to the
+next bucket, executed through the wrapped model's own jit-compiled
+predict program (whose cache is keyed on the padded shape), and the pad
+rows sliced off the result.  Under randomized request sizes at most
+``log2(max_batch) + 1`` distinct shapes ever compile; each new bucket is
+logged once so an operator can audit the bound from the server log.
+
+Padding is semantically invisible: every bundled model predicts row-wise
+(binning, tree descent, matvec are all per-row), so appending zero rows
+cannot change real-row outputs — ``tests/test_serve.py`` pins exact
+(bit-identical) single-row vs batched parity across model families.
+
+Model families are adapted uniformly:
+
+* anything with ``predict(X)`` over dense rows — :class:`HistGBT`,
+  :class:`GBLinear`, :class:`FM`, the external-memory GBT (same class);
+* :class:`SparseHistGBT` — dense request rows are expanded to an
+  all-entries-present CSR (a dense row's zeros are VALUES, not absence);
+* the sklearn wrappers — routed through ``_predict_native`` so the
+  objective's output transform is applied, including the wrapper's own
+  sparse-model path (explicit-zero scipy CSR keeps value semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.serve.instruments import serve_metrics
+
+__all__ = ["ModelRunner"]
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def _dense_as_csr(X: np.ndarray):
+    """Dense rows → (offset, index, value) CSR with EVERY entry present.
+
+    A dense request row means "here are all F values", so the CSR the
+    sparse engine sees must carry explicit entries for zeros — dropping
+    them (scipy's default densify inverse) would silently turn value-0
+    into absent ≡ missing and change predictions."""
+    n, F = X.shape
+    offset = np.arange(0, n * F + 1, F, dtype=np.int64)
+    index = np.tile(np.arange(F, dtype=np.int64), n)
+    return offset, index, np.ascontiguousarray(X.reshape(-1), np.float32)
+
+
+def _native_predict_fn(model: Any) -> Callable[[np.ndarray], np.ndarray]:
+    """Resolve a uniform dense-rows → predictions callable for any
+    supported model family (see module docstring)."""
+    if hasattr(model, "_predict_native"):        # sklearn wrappers
+        def call_wrapper(X: np.ndarray) -> np.ndarray:
+            from dmlc_core_tpu.models.histgbt_sparse import SparseHistGBT
+
+            if isinstance(model.model, SparseHistGBT):
+                import scipy.sparse as sp
+
+                n, F = X.shape
+                offset, index, value = _dense_as_csr(X)
+                csr = sp.csr_matrix((value, index, offset), shape=(n, F))
+                return np.asarray(model._predict_native(csr))
+            return np.asarray(model._predict_native(X))
+
+        return call_wrapper
+    if type(model).__name__ == "SparseHistGBT":   # native sparse engine
+        def call_sparse(X: np.ndarray) -> np.ndarray:
+            offset, index, value = _dense_as_csr(X)
+            return np.asarray(model.predict(offset, index, value))
+
+        return call_sparse
+    CHECK(hasattr(model, "predict"),
+          f"ModelRunner: {type(model).__name__} has no predict()")
+    return lambda X: np.asarray(model.predict(X))
+
+
+class ModelRunner:
+    """Wrap a trained model into a bucket-padded batch executor.
+
+    ``max_batch`` and ``min_bucket`` must be powers of two; request
+    batches larger than ``max_batch`` are chunked.  The runner is
+    stateless between calls apart from the compiled-shape audit set and
+    is safe to call from one executor thread at a time (the batcher's
+    flush thread) — model predict programs themselves are jax-thread-
+    safe, but serial execution is the contract the batcher provides.
+    """
+
+    def __init__(self, model: Any, max_batch: int = 1024,
+                 min_bucket: int = 8, name: str = "default"):
+        CHECK(_is_pow2(max_batch),
+              f"max_batch must be a power of two, got {max_batch}")
+        CHECK(_is_pow2(min_bucket) and min_bucket <= max_batch,
+              f"min_bucket must be a power of two <= max_batch, "
+              f"got {min_bucket}")
+        self.model = model
+        self.max_batch = max_batch
+        self.min_bucket = min_bucket
+        #: metrics label — a role name, not a per-instance id
+        self.name = name
+        self._predict = _native_predict_fn(model)
+        #: bucket sizes whose shape has been executed (== compiled at
+        #: least once by the model's jit cache) — the audit surface for
+        #: the log2(max_batch)+1 compile bound
+        self.compiled_shapes: set = set()
+
+    @property
+    def shape_bound(self) -> int:
+        """Maximum distinct batch shapes this runner can ever execute:
+        one per bucket on the [min_bucket, max_batch] pow-2 ladder."""
+        return (self.max_batch.bit_length()
+                - self.min_bucket.bit_length() + 1)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket holding ``n`` rows (n <= max_batch)."""
+        CHECK(1 <= n <= self.max_batch,
+              f"bucket_for: n={n} outside [1, {self.max_batch}]")
+        return max(self.min_bucket, 1 << (n - 1).bit_length())
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Score ``[n, F]`` dense rows (any n >= 1); returns predictions
+        for exactly the real rows, in order."""
+        X = np.ascontiguousarray(X, np.float32)
+        if X.ndim == 1:
+            X = X[None, :]
+        CHECK(X.ndim == 2 and len(X) >= 1,
+              f"ModelRunner.predict: want [n, F] rows, got {X.shape}")
+        outs = [self._predict_bucket(X[lo:lo + self.max_batch])
+                for lo in range(0, len(X), self.max_batch)]
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
+    __call__ = predict
+
+    def _predict_bucket(self, xb: np.ndarray) -> np.ndarray:
+        k = len(xb)
+        b = self.bucket_for(k)
+        if b > k:
+            xb = np.concatenate(
+                [xb, np.zeros((b - k, xb.shape[1]), np.float32)])
+        if b not in self.compiled_shapes:
+            self.compiled_shapes.add(b)
+            LOG("INFO",
+                "serve.runner %s: new batch bucket %d rows "
+                "(%d distinct shapes so far; bound log2(max_batch)+1 = %d)",
+                self.name, b, len(self.compiled_shapes), self.shape_bound)
+            if _metrics.enabled():
+                serve_metrics()["compiled_shapes"].set(
+                    len(self.compiled_shapes), runner=self.name)
+        if _metrics.enabled():
+            m = serve_metrics()
+            m["rows"].inc(k, runner=self.name)
+            m["pad_rows"].inc(b - k, runner=self.name)
+            with m["execute"].time(runner=self.name):
+                preds = self._predict(xb)
+        else:
+            preds = self._predict(xb)
+        return np.asarray(preds)[:k]

@@ -144,8 +144,8 @@ t_rows_total{format="libsvm"} 30
 
 _SAMPLE_RE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})? '
     r'[-+]?(\d+(\.\d+)?([eE][-+]?\d+)?|Inf|NaN)$')
 _COMMENT_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
 
@@ -155,6 +155,46 @@ def _assert_prometheus_parses(text):
     check a real scraper effectively performs."""
     for line in text.strip().split("\n"):
         assert _COMMENT_RE.match(line) or _SAMPLE_RE.match(line), line
+
+
+#: hostile label values — exactly what lands in label position once model
+#: names and checkpoint URIs are labels on the serving /metrics endpoint
+_HOSTILE_GOLDEN = """\
+# HELP e_info source has a \\\\ backslash\\nand a newline
+# TYPE e_info gauge
+e_info{source="back\\\\slash"} 1
+e_info{source="mem:///models/\\"quoted\\" v2"} 1
+e_info{source="multi\\nline"} 1
+"""
+
+
+class TestLabelEscaping:
+    """The exposition format's escaping rules, pinned against values a
+    serving deployment actually produces (URIs, model names)."""
+
+    @staticmethod
+    def _hostile_registry():
+        r = M.MetricsRegistry(namespace="e")
+        g = r.gauge("info", 'source has a \\ backslash\nand a newline',
+                    labels=("source",))
+        g.set(1, source='mem:///models/"quoted" v2')
+        g.set(1, source="back\\slash")
+        g.set(1, source="multi\nline")
+        return r
+
+    def test_hostile_label_values_golden(self):
+        assert self._hostile_registry().to_prometheus() == _HOSTILE_GOLDEN
+
+    def test_hostile_label_values_parse(self):
+        _assert_prometheus_parses(self._hostile_registry().to_prometheus())
+
+    def test_escape_order_backslash_first(self):
+        # escaping backslash last would double-escape the other escapes:
+        # '"' -> '\\"' -> '\\\\"' (wrong).  Pin the composition.
+        assert M._escape_label('a"b') == 'a\\"b'
+        assert M._escape_label("a\\nb") == "a\\\\nb"   # literal \n chars
+        assert M._escape_label("a\nb") == "a\\nb"      # real newline
+        assert M._escape_help("h\\x\ny") == "h\\\\x\\ny"
 
 
 class TestExporters:

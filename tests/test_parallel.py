@@ -287,6 +287,28 @@ class TestCheckpoint:
         version, state = load_checkpoint("/nonexistent/path/ckpt", like)
         assert version == 0 and state is like
 
+    def test_versioned_round_trip_memory_uri(self):
+        """The (version, state) contract over the mem:// backend that
+        the serve registry's hot-swap rides: the version number written
+        round-trips EXACTLY (not approximately, not re-derived), and
+        successive saves to the same URI supersede cleanly."""
+        like = {"w": jnp.zeros(3), "step": 0}
+        for v in (1, 2, 9):                    # monotone publish history
+            checkpoint("mem:///ckpt/versioned",
+                       {"w": jnp.full(3, float(v)), "step": v}, version=v)
+            version, state = load_checkpoint("mem:///ckpt/versioned", like)
+            assert version == v
+            np.testing.assert_array_equal(np.asarray(state["w"]),
+                                          np.full(3, v, np.float32))
+            assert state["step"] == v
+
+    def test_version_zero_when_absent_memory_uri(self):
+        """Cold-start contract on mem:// too: no checkpoint ⇒ version 0
+        and the caller's ``like`` handed back untouched."""
+        like = {"w": jnp.zeros(2)}
+        version, state = load_checkpoint("mem:///ckpt/never-written", like)
+        assert version == 0 and state is like
+
     def test_sharded_arrays_preserve_sharding(self):
         with TemporaryDirectory() as tmp:
             uri = os.path.join(tmp.path, "ck.bin")

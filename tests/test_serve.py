@@ -1,0 +1,385 @@
+"""Serving subsystem: runner bucket padding + parity, dynamic batcher
+contracts (coalescing, deadline flush, backpressure, timeout/cancel,
+drain), versioned registry with hot-swap, serve-bench percentile math.
+
+The HTTP frontend has its own module (tests/test_serve_http.py); these
+tests stay socket-free so batcher/runner failures localize."""
+
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bench as bench_mod
+from dmlc_core_tpu.base.logging import Error
+from dmlc_core_tpu.base import metrics as M
+from dmlc_core_tpu.serve import (BatcherClosedError, DynamicBatcher,
+                                 ModelRegistry, ModelRunner, QueueFullError,
+                                 checkpoint_model, load_model_checkpoint)
+
+
+def _make_data(n=600, F=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def _fit_histgbt(X, y):
+    from dmlc_core_tpu.models import HistGBT
+
+    return HistGBT(n_trees=3, max_depth=3, n_bins=16).fit(X, y)
+
+
+def _fit_sparse(X, y):
+    from dmlc_core_tpu.models import SparseHistGBT
+
+    n, F = X.shape
+    offset = np.arange(0, n * F + 1, F, dtype=np.int64)
+    index = np.tile(np.arange(F, dtype=np.int64), n)
+    m = SparseHistGBT(n_trees=3, max_depth=3, n_bins=16)
+    m.fit(offset, index, X.reshape(-1).copy(), y, n_features=F)
+    # direct-prediction oracle for the runner's dense-as-present CSR
+    m._dense_oracle = lambda Z: m.predict(
+        np.arange(0, len(Z) * F + 1, F, dtype=np.int64),
+        np.tile(np.arange(F, dtype=np.int64), len(Z)),
+        np.ascontiguousarray(Z.reshape(-1), np.float32))
+    return m
+
+
+def _fit_linear(X, y):
+    from dmlc_core_tpu.models import GBLinear
+
+    return GBLinear(n_rounds=5).fit(X, y)
+
+
+def _fit_sk_classifier(X, y):
+    from dmlc_core_tpu.models.sklearn import GBTClassifier
+
+    est = GBTClassifier(n_estimators=3, max_depth=3, n_bins=16)
+    est.fit(X, y)
+    est._dense_oracle = lambda Z: np.asarray(est._predict_native(Z))
+    return est
+
+
+def _fit_sk_regressor(X, y):
+    from dmlc_core_tpu.models.sklearn import GBTRegressor
+
+    est = GBTRegressor(n_estimators=3, max_depth=3, n_bins=16,
+                       booster="gblinear")
+    est.fit(X, np.asarray(y, np.float32))
+    est._dense_oracle = lambda Z: np.asarray(est._predict_native(Z))
+    return est
+
+
+def _oracle(model, Z):
+    fn = getattr(model, "_dense_oracle", None)
+    return fn(Z) if fn is not None else np.asarray(model.predict(Z))
+
+
+class TestModelRunner:
+    def test_bucket_ladder(self):
+        X, y = _make_data(64)
+        r = ModelRunner(_fit_linear(X, y), max_batch=64, min_bucket=8)
+        assert r.bucket_for(1) == 8
+        assert r.bucket_for(8) == 8
+        assert r.bucket_for(9) == 16
+        assert r.bucket_for(64) == 64
+        assert r.shape_bound == 4            # 8, 16, 32, 64
+        with pytest.raises(Error):
+            r.bucket_for(65)
+        with pytest.raises(Error):
+            ModelRunner(_fit_linear(X, y), max_batch=48)  # not pow2
+
+    @pytest.mark.parametrize("fit,exact_cross_shape", [
+        (_fit_histgbt, True), (_fit_sparse, True), (_fit_linear, False),
+        (_fit_sk_classifier, True), (_fit_sk_regressor, False),
+    ], ids=["histgbt", "sparse", "linear", "sk_clf", "sk_reg_linear"])
+    def test_padding_parity(self, fit, exact_cross_shape):
+        """Padding must not change real-row outputs.  The EXACT claim is
+        within a bucket: the same rows at the same compiled shape give
+        bit-identical results whether the tail is zero padding or real
+        rows.  Cross-shape (padded bucket vs the model's own unpadded
+        shape) is also exact for the tree engines (per-row bin + descend
+        has no cross-row reduction); dense matmul models may differ by
+        BLAS summation order across shapes, so those get a tight
+        allclose."""
+        X, y = _make_data(200)
+        model = fit(X, y)
+        r = ModelRunner(model, max_batch=64, min_bucket=8)
+        # exact within-bucket: rows 0..36 through bucket 64, tail = zero
+        # padding vs tail = real rows — identical shape, identical rows
+        np.testing.assert_array_equal(r.predict(X[:37]),
+                                      r.predict(X[:64])[:37])
+        # cross-shape vs the model's own direct prediction
+        direct = _oracle(model, X[:37])
+        assert_fn = (np.testing.assert_array_equal if exact_cross_shape
+                     else lambda a, b: np.testing.assert_allclose(
+                         a, b, rtol=1e-6, atol=1e-7))
+        assert_fn(r.predict(X[:37]), direct)
+        for i in (0, 3, 36):                      # single rows pad 1 -> 8
+            assert_fn(r.predict(X[i:i + 1]), direct[i:i + 1])
+
+    def test_chunks_oversized_batches(self):
+        X, y = _make_data(300)
+        model = _fit_histgbt(X, y)
+        r = ModelRunner(model, max_batch=64, min_bucket=8)
+        np.testing.assert_array_equal(r.predict(X[:300]),
+                                      _oracle(model, X[:300]))
+
+    def test_compiled_shape_bound_and_log(self, caplog):
+        """Randomized request sizes land in <= log2(max_batch)+1 shapes,
+        and every new bucket leaves an auditable log line."""
+        X, y = _make_data(300)
+        r = ModelRunner(_fit_histgbt(X, y), max_batch=256, min_bucket=8)
+        rng = np.random.default_rng(1)
+        with caplog.at_level(logging.INFO, logger="dmlc"):
+            for _ in range(40):
+                k = int(rng.integers(1, 257))
+                r.predict(X[:k])
+        assert len(r.compiled_shapes) <= r.shape_bound
+        assert r.shape_bound <= 256 .bit_length()     # log2(max)+1 = 9
+        lines = [m for m in caplog.messages if "new batch bucket" in m]
+        assert len(lines) == len(r.compiled_shapes)
+        assert "bound log2(max_batch)+1" in lines[0]
+
+
+def _echo_execute(X):
+    """Deterministic per-row function so split results are checkable."""
+    return X[:, 0] * 2.0 + X[:, 1]
+
+
+def _req(v0, v1=0.0, k=1):
+    out = np.zeros((k, 2), np.float32)
+    out[:, 0] = v0
+    out[:, 1] = v1
+    return out
+
+
+class TestDynamicBatcher:
+    def test_concurrent_producers_get_their_own_rows(self):
+        with DynamicBatcher(_echo_execute, max_batch=32, max_delay=0.005,
+                            max_queue=512, name="t-conc") as b:
+            results = {}
+            lock = threading.Lock()
+
+            def producer(tid):
+                futs = []
+                for i in range(25):
+                    futs.append((i, b.submit(_req(tid, i, k=1 + i % 3))))
+                for i, f in futs:
+                    preds, _ = f.result(timeout=10)
+                    with lock:
+                        results[(tid, i)] = preds
+
+            threads = [threading.Thread(target=producer, args=(t,))
+                       for t in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(results) == 8 * 25
+            for (tid, i), preds in results.items():
+                np.testing.assert_allclose(preds, tid * 2.0 + i)
+                assert len(preds) == 1 + i % 3
+
+    def test_deadline_flush_fires_partial_batch(self):
+        M.default_registry().reset()
+        with DynamicBatcher(_echo_execute, max_batch=1024, max_delay=0.05,
+                            max_queue=8, name="t-deadline") as b:
+            t0 = time.monotonic()
+            f = b.submit(_req(3.0, k=2))
+            preds, _ = f.result(timeout=5)
+            waited = time.monotonic() - t0
+        np.testing.assert_allclose(preds, 6.0)
+        assert waited >= 0.04                 # held for the deadline...
+        assert waited < 2.0                   # ...not for max_batch rows
+        h = M.default_registry().histogram("serve_batch_rows",
+                                           labels=("batcher",))
+        assert h.count(batcher="t-deadline") == 1
+
+    def test_backpressure_rejects_when_queue_full(self):
+        gate = threading.Event()
+
+        def blocked(X):
+            gate.wait(10)
+            return _echo_execute(X)
+
+        b = DynamicBatcher(blocked, max_batch=4, max_delay=0.0,
+                           max_queue=2, name="t-full")
+        try:
+            first = b.submit(_req(1.0))       # picked up by flush thread
+            time.sleep(0.15)                  # ensure it's mid-execute
+            b.submit(_req(2.0))
+            b.submit(_req(3.0))               # queue now full (2)
+            with pytest.raises(QueueFullError):
+                b.submit(_req(4.0))
+        finally:
+            gate.set()
+            b.close()
+        assert first.result(timeout=5)[0] is not None
+
+    def test_timeout_cancels_stuck_request(self):
+        gate = threading.Event()
+
+        def blocked(X):
+            gate.wait(10)
+            return _echo_execute(X)
+
+        b = DynamicBatcher(blocked, max_batch=4, max_delay=0.0,
+                           max_queue=8, name="t-timeout")
+        try:
+            b.submit(_req(1.0))               # occupies the flush thread
+            time.sleep(0.15)
+            stuck = b.submit(_req(2.0), timeout=0.01)
+            time.sleep(0.1)                   # expire while queued
+            gate.set()
+            with pytest.raises(TimeoutError):
+                stuck.result(timeout=5)
+        finally:
+            gate.set()
+            b.close()
+
+    def test_cancelled_future_never_executes(self):
+        gate = threading.Event()
+        seen = []
+
+        def blocked(X):
+            gate.wait(10)
+            seen.append(len(X))
+            return _echo_execute(X)
+
+        b = DynamicBatcher(blocked, max_batch=4, max_delay=0.0,
+                           max_queue=8, name="t-cancel")
+        try:
+            b.submit(_req(1.0))
+            time.sleep(0.15)
+            victim = b.submit(_req(2.0))
+            assert victim.cancel()            # still queued -> cancellable
+            gate.set()
+            b.close()
+            assert victim.cancelled()
+            assert sum(seen) == 1             # only the first row ran
+        finally:
+            gate.set()
+            b.close()
+
+    def test_drain_on_close_completes_in_flight_futures(self):
+        def slowish(X):
+            time.sleep(0.01)
+            return _echo_execute(X)
+
+        b = DynamicBatcher(slowish, max_batch=2, max_delay=0.0,
+                           max_queue=128, name="t-drain")
+        futs = [b.submit(_req(float(i))) for i in range(40)]
+        b.close(drain=True)
+        for i, f in enumerate(futs):
+            preds, _ = f.result(timeout=1)    # already resolved by close
+            np.testing.assert_allclose(preds, i * 2.0)
+        with pytest.raises(BatcherClosedError):
+            b.submit(_req(0.0))
+
+    def test_execute_failure_fails_the_batch_not_the_batcher(self):
+        calls = []
+
+        def flaky(X):
+            calls.append(len(X))
+            if len(calls) == 1:
+                raise ValueError("boom")
+            return _echo_execute(X)
+
+        with DynamicBatcher(flaky, max_batch=4, max_delay=0.0,
+                            max_queue=8, name="t-flaky") as b:
+            bad = b.submit(_req(1.0))
+            with pytest.raises(ValueError, match="boom"):
+                bad.result(timeout=5)
+            good = b.submit(_req(2.0))
+            np.testing.assert_allclose(good.result(timeout=5)[0], 4.0)
+
+
+class _FakeModel:
+    """predict-only stand-in (registry publish does not serialize)."""
+
+    def __init__(self, scale):
+        self.scale = scale
+
+    def predict(self, X):
+        return X[:, 0] * self.scale
+
+
+class TestModelRegistry:
+    def test_publish_monotonic_and_rollback(self):
+        reg = ModelRegistry(name="t-reg", max_batch=8, min_bucket=1)
+        assert reg.current_version() is None
+        v1 = reg.publish(_FakeModel(1.0))
+        v2 = reg.publish(_FakeModel(2.0))
+        assert (v1, v2) == (1, 2)
+        assert reg.current_version() == 2
+        with pytest.raises(Error):
+            reg.publish(_FakeModel(3.0), version=2)    # stale version
+        reg.activate(1)                                # rollback
+        assert reg.current_version() == 1
+        assert reg.versions() == [1, 2]
+        with pytest.raises(Error):
+            reg.activate(99)
+
+    def test_inflight_batch_finishes_on_old_version(self):
+        """The hot-swap contract: a batch that resolved current() before
+        the swap completes on THAT version; the next batch sees the new
+        one."""
+        reg = ModelRegistry(name="t-swap", max_batch=8, min_bucket=1)
+        reg.publish(_FakeModel(10.0))
+        entered = threading.Event()
+        gate = threading.Event()
+
+        def execute(X):
+            version, runner = reg.current()
+            entered.set()
+            gate.wait(10)                # swap happens while in flight
+            return runner.predict(X), version
+
+        with DynamicBatcher(execute, max_batch=4, max_delay=0.0,
+                            max_queue=8, name="t-swap") as b:
+            f1 = b.submit(_req(1.0))
+            assert entered.wait(5)
+            reg.publish(_FakeModel(100.0))             # hot-swap
+            gate.set()
+            preds1, v_1 = f1.result(timeout=5)
+            preds2, v_2 = b.submit(_req(1.0)).result(timeout=5)
+        assert (v_1, v_2) == (1, 2)
+        np.testing.assert_allclose(preds1, 10.0)       # old model finished
+        np.testing.assert_allclose(preds2, 100.0)      # new model serves
+
+    def test_checkpoint_load_save_round_trip(self):
+        X, y = _make_data(200)
+        model = _fit_histgbt(X, y)
+        checkpoint_model("mem:///serve-reg/v7", model, version=7)
+        reg = ModelRegistry(name="t-ckpt", max_batch=16, min_bucket=4)
+        assert reg.load("mem:///serve-reg/v7") == 7
+        _, runner = reg.current()
+        np.testing.assert_array_equal(runner.predict(X[:5]),
+                                      model.predict(X[:5]))
+        reg.save("mem:///serve-reg/resaved")
+        v, again = load_model_checkpoint("mem:///serve-reg/resaved")
+        assert v == 7
+        np.testing.assert_array_equal(again.predict(X[:5]),
+                                      model.predict(X[:5]))
+        with pytest.raises(Error):
+            reg.load("mem:///serve-reg/never-written")  # absent is loud
+        with pytest.raises(Error):
+            checkpoint_model("mem:///serve-reg/v0", model, version=0)
+
+
+class TestServeBenchHelpers:
+    def test_latency_summary_percentiles(self):
+        lats = [i / 1000.0 for i in range(1, 101)]     # 1..100 ms
+        s = bench_mod.latency_summary(lats)
+        assert s["latency_p50_ms"] == pytest.approx(50.0, abs=1.5)
+        assert s["latency_p95_ms"] == pytest.approx(95.0, abs=1.5)
+        assert s["latency_p99_ms"] == pytest.approx(99.0, abs=1.5)
+        assert s["latency_mean_ms"] == pytest.approx(50.5, abs=0.1)
+
+    def test_latency_summary_empty(self):
+        assert bench_mod.latency_summary([])["latency_p50_ms"] is None
