@@ -1,0 +1,282 @@
+"""Persistent XLA compilation cache wiring + cold-start instrumentation.
+
+BENCH_r05 measured `warmup_seconds: 31.0` against `seconds: 12.4` of
+actual training on the north-star config — the XLA compiles that
+dominate that half minute are re-paid by every ``bench.py`` run, every
+elastic-recovery relaunch, and every serve restart, even though the
+programs are byte-identical each time.  JAX ships a persistent
+compilation cache (serialized executables keyed on the HLO + device
+topology) that turns a repeat compile into a disk read; this module is
+the ONE place that wires it, so every engine (in-core / external /
+sparse GBT, serve runners, bench) gets warm-start behavior through a
+single pair of env knobs:
+
+* ``DMLC_COMPILE_CACHE`` — default on; ``0`` disables (no jax config is
+  touched at all);
+* ``DMLC_COMPILE_CACHE_DIR`` — cache directory.  Unset: an already-
+  configured jax cache dir (e.g. the test harness's) is adopted as-is,
+  else ``~/.cache/dmlc_core_tpu/xla_compile_cache``.
+
+When enabled, the write thresholds are opened up
+(``jax_persistent_cache_min_compile_time_secs=0``, no minimum entry
+size): this substrate compiles a few dozen distinct programs at most,
+and a sub-second program that a serve restart would otherwise recompile
+per bucket is exactly what the cache exists to skip.
+
+Instrumentation: jax's monitoring events for cache hits / misses /
+compile-time-saved are forwarded into :mod:`dmlc_core_tpu.base.metrics`
+(``dmlc_compile_cache_events_total{event=hit|miss}``,
+``dmlc_compile_cache_saved_seconds_total``) and mirrored in process-
+local counters that :func:`stats` reports even with metrics disabled —
+``bench.py`` stamps its final JSON with ``compile_cache: hit|miss``
+from exactly this.
+
+:class:`BackgroundCompiler` is the shared cold-start overlap helper:
+it runs AOT ``lower(...).compile()`` thunks concurrently on
+:class:`~dmlc_core_tpu.io.thread_group.ThreadGroup` workers so compiles
+proceed while ingest (quantile sketch, binning, H2D staging) runs on
+the main thread — see ``models/histgbt.py`` for the flagship consumer
+and ``doc/performance.md`` for the full cold-start story.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base.logging import LOG
+from dmlc_core_tpu.base.parameter import get_env
+from dmlc_core_tpu.base.timer import get_time
+
+__all__ = [
+    "BackgroundCompiler", "cache_dir", "compile_cache_metrics",
+    "configure", "enabled", "set_cache_dir", "stats",
+]
+
+#: default on-disk location when neither ``DMLC_COMPILE_CACHE_DIR`` nor
+#: an existing jax cache dir says otherwise
+_DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
+                            "dmlc_core_tpu", "xla_compile_cache")
+
+_lock = threading.Lock()
+#: process-local event counts (kept even when base.metrics is disabled
+#: — stats() is evidence for bench records, not optional telemetry)
+_counts = {"hits": 0, "misses": 0, "saved_seconds": 0.0}
+_listeners_registered = False
+
+_M: Dict[str, Any] = {}
+
+
+def compile_cache_metrics() -> Dict[str, Any]:
+    """Lazily declared instrument handles in the default registry."""
+    if not _M:
+        r = _metrics.default_registry()
+        _M.update({
+            "events": r.counter(
+                "compile_cache_events_total",
+                "persistent XLA compile cache events (hit = executable "
+                "deserialized from disk, miss = compiled then written)",
+                labels=("event",)),
+            "saved": r.counter(
+                "compile_cache_saved_seconds_total",
+                "compile seconds skipped via persistent-cache hits "
+                "(original compile time minus retrieval time)"),
+            "compile": r.histogram(
+                "compile_seconds",
+                "wall seconds per AOT program compile (cache hits "
+                "included — they appear as near-zero observations)",
+                labels=("what",)),
+        })
+    return _M
+
+
+def _on_event(event: str, **kw: Any) -> None:
+    name = {"/jax/compilation_cache/cache_hits": "hit",
+            "/jax/compilation_cache/cache_misses": "miss"}.get(event)
+    if name is None:
+        return
+    with _lock:
+        _counts[name + ("s" if name == "hit" else "es")] += 1
+    if _metrics.enabled():
+        compile_cache_metrics()["events"].inc(1, event=name)
+
+
+def _on_duration(event: str, duration_secs: float, **kw: Any) -> None:
+    if event != "/jax/compilation_cache/compile_time_saved_sec":
+        return
+    with _lock:
+        _counts["saved_seconds"] += max(duration_secs, 0.0)
+    if _metrics.enabled():
+        compile_cache_metrics()["saved"].inc(max(duration_secs, 0.0))
+
+
+def _register_listeners() -> None:
+    """Forward jax's cache monitoring events — once per process.  The
+    listeners only count, so they are registered unconditionally: the
+    test harness enables the jax cache on its own and the counters must
+    reflect that reality too."""
+    global _listeners_registered
+    with _lock:
+        if _listeners_registered:
+            return
+        _listeners_registered = True
+    from jax._src import monitoring
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+_register_listeners()
+
+
+def enabled() -> bool:
+    """``DMLC_COMPILE_CACHE`` (default on)."""
+    return get_env("DMLC_COMPILE_CACHE", True, bool)
+
+
+def cache_dir() -> Optional[str]:
+    """The jax cache directory currently in effect (None = no cache)."""
+    return jax.config.jax_compilation_cache_dir
+
+
+def configure() -> bool:
+    """Idempotently wire jax's persistent compilation cache from env.
+
+    Safe to call before every compile site (each engine does).  Returns
+    True when the cache is active.  ``DMLC_COMPILE_CACHE=0`` is a
+    strict no-op: nothing in jax.config is touched.  A cache dir the
+    process already configured (e.g. tests/conftest.py) is adopted
+    unless ``DMLC_COMPILE_CACHE_DIR`` explicitly overrides it.
+    """
+    if not enabled():
+        return False
+    env_dir = get_env("DMLC_COMPILE_CACHE_DIR", "")
+    current = jax.config.jax_compilation_cache_dir
+    target = env_dir or current or _DEFAULT_DIR
+    if target != current:
+        set_cache_dir(target)
+    else:
+        _open_thresholds()
+    return True
+
+
+def set_cache_dir(path: str) -> None:
+    """Point the persistent cache at ``path`` (created lazily by jax).
+
+    Also resets jax's sticky cache handle so a redirect AFTER a compile
+    has happened takes effect — without the reset the first-initialized
+    directory would silently keep winning (test isolation needs this).
+    """
+    from jax.experimental.compilation_cache import compilation_cache as cc
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    _open_thresholds()
+    cc.reset_cache()
+    LOG("DEBUG", "compile_cache: persistent XLA cache at %s", path)
+
+
+def _open_thresholds() -> None:
+    """Cache EVERY program: the default 1 s compile-time floor would
+    skip most CPU-backend programs and every small serve bucket — the
+    exact compiles a warm restart must not re-pay."""
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+def stats() -> Dict[str, Any]:
+    """Process-local cache evidence: enabled state, directory, and
+    hit/miss/saved-seconds counts since process start."""
+    with _lock:
+        counts = dict(_counts)
+    return {"enabled": enabled(), "dir": cache_dir(), **counts}
+
+
+def marker() -> Tuple[int, int]:
+    """(hits, misses) snapshot; pair with :func:`verdict`."""
+    with _lock:
+        return _counts["hits"], _counts["misses"]
+
+
+def verdict(mark: Tuple[int, int]) -> Optional[str]:
+    """Classify cache activity since ``mark``: ``"hit"`` (served at
+    least partly from disk, nothing newly compiled), ``"miss"``
+    (something compiled + written), or None (no cache traffic — cache
+    off, or every program came from jax's in-memory caches)."""
+    hits, misses = marker()
+    dh, dm = hits - mark[0], misses - mark[1]
+    if dm > 0:
+        return "miss"
+    if dh > 0:
+        return "hit"
+    return None
+
+
+class BackgroundCompiler:
+    """Run named compile thunks concurrently on daemon workers.
+
+    The cold-start overlap primitive (see module docstring): each thunk
+    typically does ``jit(fn).lower(*avals).compile()`` and returns the
+    compiled executable; workers run while the caller's main thread
+    does ingest work, and :meth:`join` blocks only for whatever compile
+    time the ingest did not already cover.
+
+    Failures never propagate: a thunk that raises is logged once and
+    simply missing from the results — callers fall back to the inline
+    jit path, which recompiles (and usually hits the just-written
+    persistent cache).  ``compile_seconds`` after join is the longest
+    single worker wall (the critical path; workers run concurrently),
+    ``join_wait_seconds`` the non-overlapped residue the caller paid.
+    """
+
+    def __init__(self, jobs: Dict[str, Callable[[], Any]],
+                 what: str = "warmup") -> None:
+        from dmlc_core_tpu.io.thread_group import ThreadGroup
+
+        configure()
+        self._what = what
+        self._results: Dict[str, Any] = {}
+        self._errors: Dict[str, BaseException] = {}
+        self._walls: Dict[str, float] = {}
+        self._mark = marker()
+        self._joined = False
+        self.compile_seconds = 0.0
+        self.join_wait_seconds = 0.0
+        self.cache_verdict: Optional[str] = None
+        self._grp = ThreadGroup()
+        for name, thunk in jobs.items():
+            self._grp.create(f"compile-{name}",
+                             self._runner(name, thunk))
+
+    def _runner(self, name: str, thunk: Callable[[], Any]):
+        def run(_shutdown) -> None:
+            t0 = get_time()
+            try:
+                self._results[name] = thunk()
+            except BaseException as e:  # noqa: BLE001 — surfaced at join
+                self._errors[name] = e
+            finally:
+                self._walls[name] = get_time() - t0
+                if _metrics.enabled():
+                    compile_cache_metrics()["compile"].observe(
+                        self._walls[name], what=f"{self._what}:{name}")
+        return run
+
+    def join(self) -> Dict[str, Any]:
+        """Wait for every worker; returns name → compiled result
+        (failed thunks are absent — see class docstring)."""
+        if self._joined:
+            return self._results
+        t0 = get_time()
+        self._grp.join_all()
+        self._joined = True
+        self.join_wait_seconds = get_time() - t0
+        self.compile_seconds = max(self._walls.values(), default=0.0)
+        self.cache_verdict = verdict(self._mark)
+        for name, err in self._errors.items():
+            LOG("WARNING", "background compile %r failed "
+                "(%s: %s) — falling back to inline jit compile",
+                name, type(err).__name__, err)
+        return self._results

@@ -31,6 +31,7 @@ is ~1 full MXU row-pass instead of 6.
 from __future__ import annotations
 
 import os
+from collections import deque
 from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -41,9 +42,10 @@ import jax.numpy as jnp
 from dmlc_core_tpu.base.compat import donate_argnums, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_core_tpu.base import compile_cache as _cc
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG, log_fatal
-from dmlc_core_tpu.base.parameter import Parameter, field
+from dmlc_core_tpu.base.parameter import Parameter, field, get_env
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 from dmlc_core_tpu.ops.histogram import (build_histogram,
@@ -72,6 +74,161 @@ __all__ = ["HistGBT", "HistGBTParam", "OBJECTIVES"]
 #: input shape — a long-lived many-shape process can
 #: ``_ROUND_FN_CACHE.clear()`` to release everything.
 _ROUND_FN_CACHE: Dict[tuple, Any] = {}
+
+#: process-wide AOT-compiled round executables, keyed on
+#: (:meth:`HistGBT._round_fn_cache_key`, n_features, n_padded).  The
+#: executable level of ``_ROUND_FN_CACHE``: where that cache shares the
+#: *jitted wrapper* (one compile per padded shape via jax.jit's own
+#: cache), this one holds the ``lower().compile()`` results the
+#: cold-start warmup produces, so a repeated fit at the same shape —
+#: bench re-measure, elastic-recovery relaunch — dispatches with zero
+#: trace/compile work.  Same lifetime/clearing story as
+#: ``_ROUND_FN_CACHE``.
+_AOT_EXEC_CACHE: Dict[tuple, Any] = {}
+
+
+def _rounds_schedule(n_trees: int, eval_every: int = 0) -> Tuple[int, int]:
+    """(rounds per dispatch K, remainder) — the dispatch chunking both
+    ``_boost_binned`` and the cold-start warmup must agree on."""
+    k_env = int(os.environ.get("DMLC_TPU_ROUNDS_PER_DISPATCH", 25))
+    CHECK(k_env >= 1,
+          f"DMLC_TPU_ROUNDS_PER_DISPATCH must be >= 1, got {k_env}")
+    K = min(n_trees, k_env)
+    if eval_every:
+        # chunk boundaries must land on eval rounds: use the largest
+        # divisor of eval_every ≤ K (gcd alone would collapse to 1
+        # for e.g. eval_every=7, paying per-dispatch latency 7×)
+        K = max(d for d in range(1, K + 1) if eval_every % d == 0)
+    return K, n_trees % K
+
+
+def _ingest_chunk_rows(ndev: int) -> int:
+    """Rows per streamed-ingest chunk (``DMLC_INGEST_CHUNK_ROWS``,
+    default 2M; 0 disables streaming), rounded down to a mesh-size
+    multiple so every chunk device_puts onto the row sharding."""
+    rows = get_env("DMLC_INGEST_CHUNK_ROWS", 2_000_000, int)
+    if rows <= 0:
+        return 0
+    return max(1, rows // ndev) * ndev
+
+
+@lru_cache(maxsize=32)
+def _bin_chunk_fn(mesh: Mesh, missing: bool, miss_bin: int):
+    """Jitted per-(mesh, mode) chunk binning: digitize a row-sharded
+    f32 slab against the cuts and emit it feature-major — the streamed
+    ingest's per-chunk kernel (cuts ride as a traced arg so one program
+    serves every fit on the mesh)."""
+    def f(xc, cuts):
+        b = (apply_bins_missing(xc, cuts, miss_bin) if missing
+             else apply_bins(xc, cuts))
+        return b.T
+    return jax.jit(f, out_shardings=NamedSharding(mesh, P(None, "data")))
+
+
+@lru_cache(maxsize=64)
+def _concat_feature_major_fn(mesh: Mesh, n_pieces: int):
+    """Jitted concat of binned chunks along rows (feature-major axis 1)
+    — peak HBM is ~2× the uint8 matrix, vs the whole-matrix path's
+    f32-plus-uint8 (~5×)."""
+    del n_pieces  # part of the key: one program per piece count
+    return jax.jit(lambda *ps: jnp.concatenate(ps, axis=1),
+                   out_shardings=NamedSharding(mesh, P(None, "data")))
+
+
+class _RoundProgramWarmup:
+    """Round-program compiles running concurrently with ingest.
+
+    Created as soon as the round program's compile-time constants are
+    pinned (cuts mode decided, shapes known) and joined by
+    ``_boost_binned`` right before the first dispatch, so XLA compiles
+    the K-round and remainder programs — BOTH in flight at once on
+    :class:`~dmlc_core_tpu.base.compile_cache.BackgroundCompiler`
+    workers, where the pre-overlap path compiled them serially inside
+    the warmup dispatch — while the quantile sketch, binning and H2D
+    staging run on the main thread.  Executables land in
+    ``_AOT_EXEC_CACHE``; with the persistent compile cache warm the
+    "compile" collapses to a disk read and ``join`` is ~instant.
+
+    Any mismatch between what was warmed and what ``_boost_binned``
+    actually needs (param mutated between kickoff and fit, different
+    eval chunking, different padded shape) is detected by key equality
+    and the handle is simply ignored — the inline jit path remains the
+    source of truth, so overlap can never change results.
+    """
+
+    def __init__(self, model: "HistGBT", n_features: int, n_padded: int,
+                 eval_every: int = 0) -> None:
+        p = model.param
+        self.n_features = n_features
+        self.n_padded = n_padded
+        self.K, self.rem = _rounds_schedule(p.n_trees, eval_every)
+        sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
+        mesh = model.mesh
+        mat = NamedSharding(mesh, P(None, "data"))
+        row = NamedSharding(mesh, P("data"))
+        margin = (NamedSharding(mesh, P("data", None))
+                  if p.num_class > 1 else row)
+        args = [
+            jax.ShapeDtypeStruct((n_features, n_padded), np.uint8,
+                                 sharding=mat),
+            jax.ShapeDtypeStruct((n_padded,), np.float32, sharding=row),
+            jax.ShapeDtypeStruct((n_padded,), np.float32, sharding=row),
+            jax.ShapeDtypeStruct(model._margin_shape(n_padded),
+                                 np.float32, sharding=margin),
+        ]
+        if sampling:
+            args.append(jax.random.key(0))   # concrete: tiny, typed aval
+        self._keys: Dict[str, tuple] = {}
+        jobs: Dict[str, Any] = {}
+        for label, n_rounds in (("kfn", self.K), ("rem", self.rem)):
+            if n_rounds == 0:
+                continue
+            key = (model._round_fn_cache_key(n_features, n_rounds),
+                   n_features, n_padded)
+            self._keys[label] = key
+            if key in _AOT_EXEC_CACHE:
+                continue                     # warmed by an earlier fit
+            jobs[label] = partial(self._compile, model, n_features,
+                                  n_rounds, tuple(args))
+        self._bg = (_cc.BackgroundCompiler(jobs, what="incore_round")
+                    if jobs else None)
+        self.compile_seconds = 0.0
+        self.join_wait_seconds = 0.0
+        self.cache_verdict: Optional[str] = None
+
+    @staticmethod
+    def _compile(model: "HistGBT", n_features: int, n_rounds: int,
+                 args: tuple):
+        fn = model._build_round_fn(n_features, n_rounds)
+        return fn.lower(*args).compile()
+
+    def join(self) -> Dict[str, Any]:
+        """Block until compiles finish; publish executables; return
+        label → executable for everything that succeeded."""
+        if self._bg is not None:
+            results = self._bg.join()
+            self.compile_seconds = self._bg.compile_seconds
+            self.join_wait_seconds = self._bg.join_wait_seconds
+            self.cache_verdict = self._bg.cache_verdict
+            for label, comp in results.items():
+                _AOT_EXEC_CACHE[self._keys[label]] = comp
+            self._bg = None
+        return {label: _AOT_EXEC_CACHE[key]
+                for label, key in self._keys.items()
+                if key in _AOT_EXEC_CACHE}
+
+    def matches(self, round_key_fn, n_features: int, n_padded: int,
+                K: int, rem: int) -> bool:
+        """True iff the warmed programs are exactly the ones the
+        imminent fit will dispatch."""
+        if (self.n_features, self.n_padded, self.K, self.rem) != \
+                (n_features, n_padded, K, rem):
+            return False
+        expect = {("kfn", K), ("rem", rem)} - {("rem", 0)}
+        return all(
+            self._keys.get(label) == (round_key_fn(n_features, n_rounds),
+                                      n_features, n_padded)
+            for label, n_rounds in expect)
 
 
 @lru_cache(maxsize=32)
@@ -201,6 +358,18 @@ class HistGBT(_ExternalMemoryEngine):
         #: recording adds no device traffic and no pipeline break.
         self.last_chunk_times: List[Tuple[int, float]] = []
         self.last_warmup_seconds: Optional[float] = None
+        #: cold-start breakdown of the last fit (doc/performance.md):
+        #: bin = quantize + stage wall (make_device_data);
+        #: compile = round-program compile critical path (overlapped
+        #: with bin when the warmup handle ran; None on the inline
+        #: path, where compile hides inside the warm dispatch);
+        #: warm_dispatch = the discarded warmup rounds' wall;
+        #: compile_cache = "hit" | "miss" | None (no cache traffic)
+        self.last_bin_seconds: Optional[float] = None
+        self.last_compile_seconds: Optional[float] = None
+        self.last_warm_dispatch_seconds: Optional[float] = None
+        self.last_compile_cache: Optional[str] = None
+        self._pending_warmup: Optional[_RoundProgramWarmup] = None
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
         self._early_stopped = False
@@ -340,7 +509,7 @@ class HistGBT(_ExternalMemoryEngine):
             Xv = np.ascontiguousarray(eval_set[0], dtype=np.float32)
             yv = np.ascontiguousarray(eval_set[1], dtype=np.float32)
             self._check_nan_allowed(Xv, "eval_set")
-            eval_bins = self._bin_matrix(jnp.asarray(Xv))
+            eval_bins = self._bin_eval_chunked(Xv)
             eval_margin = jnp.full(self._margin_shape(len(yv)),
                                    p.base_score, jnp.float32)
             if continuing:
@@ -461,19 +630,11 @@ class HistGBT(_ExternalMemoryEngine):
         early-stopping between dispatches.
         """
         p = self.param
-        # rounds per dispatch: 25 amortizes per-dispatch latency while
-        # keeping ≥2 evidence chunks at the 100-round bench shape (the
-        # anomaly detector needs per-chunk arrival deltas); overridable
-        # for experiments / very different round counts
-        k_env = int(os.environ.get("DMLC_TPU_ROUNDS_PER_DISPATCH", 25))
-        CHECK(k_env >= 1,
-              f"DMLC_TPU_ROUNDS_PER_DISPATCH must be >= 1, got {k_env}")
-        K = min(p.n_trees, k_env)
-        if eval_every:
-            # chunk boundaries must land on eval rounds: use the largest
-            # divisor of eval_every ≤ K (gcd alone would collapse to 1
-            # for e.g. eval_every=7, paying per-dispatch latency 7×)
-            K = max(d for d in range(1, K + 1) if eval_every % d == 0)
+        # rounds per dispatch (_rounds_schedule): 25 amortizes
+        # per-dispatch latency while keeping ≥2 evidence chunks at the
+        # 100-round bench shape (the anomaly detector needs per-chunk
+        # arrival deltas); overridable for experiments
+        K, rem = _rounds_schedule(p.n_trees, eval_every)
         sampling = p.subsample < 1.0 or p.colsample_bytree < 1.0
         base_key = jax.random.key(p.seed) if sampling else None
 
@@ -486,22 +647,78 @@ class HistGBT(_ExternalMemoryEngine):
                           jax.random.fold_in(base_key, done))
             return fn(bins_t, y_d, w_d, preds_c)
 
-        kfn = self._build_round_fn(n_features, K)
-        rem = p.n_trees % K
-        rem_fn = self._build_round_fn(n_features, rem) if rem else None
-        t_w = get_time()
-        if warmup_rounds > 0:
+        # join the overlapped compile (make_device_data / fit_device
+        # kicked it off before ingest); the AOT executables are used
+        # only when they are exactly the programs this fit dispatches
+        # AND the live buffers carry the shardings they were lowered
+        # for — any drift falls back to the inline jit path, which is
+        # always correct (and usually a persistent-cache hit)
+        warm = self._pending_warmup
+        self._pending_warmup = None
+        kfn = rem_fn = None
+        join_wait = 0.0
+        self.last_compile_seconds = None
+        self.last_compile_cache = None
+        if warm is not None:
+            row_sh = NamedSharding(self.mesh, P("data"))
+            margin_sh = (NamedSharding(self.mesh, P("data", None))
+                         if p.num_class > 1 else row_sh)
+            shardings_ok = (
+                bins_t.sharding == NamedSharding(self.mesh,
+                                                 P(None, "data"))
+                and y_d.sharding == row_sh and w_d.sharding == row_sh
+                and preds.sharding == margin_sh)
+            execs = warm.join()              # never leave workers behind
+            if shardings_ok and warm.matches(
+                    self._round_fn_cache_key, n_features,
+                    int(bins_t.shape[1]), K, rem):
+                kfn = execs.get("kfn")
+                rem_fn = execs.get("rem")
+                join_wait = warm.join_wait_seconds
+                self.last_compile_seconds = warm.compile_seconds
+                self.last_compile_cache = warm.cache_verdict
+        using_aot = kfn is not None and (rem == 0 or rem_fn is not None)
+        # the shared jitted program is resolved EITHER way (a dict hit
+        # when the warmup worker or an earlier fit built it): it keeps
+        # the process-wide ``_round_fn`` sharing contract, and it is
+        # the fallback the AOT dispatch path retreats to
+        kfn_jit = self._build_round_fn(n_features, K)
+        rem_jit = self._build_round_fn(n_features, rem) if rem else None
+        if kfn is None:
+            kfn = kfn_jit
+        if rem and rem_fn is None:
+            rem_fn = rem_jit
+
+        def warm_dispatch(kf, rf):
             # compile + cache-warm on a copy so the real buffer stays
             # valid and model state is untouched (preds is donated).
             # np.asarray (not block_until_ready): on remote-tunnel devices
             # only a real data fetch proves execution finished
-            warm = run(kfn, jnp.copy(preds), 0)
-            np.asarray(warm[0][:1])
-            if rem_fn is not None:
-                warm = run(rem_fn, jnp.copy(preds), 0)
-                np.asarray(warm[0][:1])
+            out = run(kf, jnp.copy(preds), 0)
+            np.asarray(out[0][:1])
+            if rf is not None:
+                out = run(rf, jnp.copy(preds), 0)
+                np.asarray(out[0][:1])
+
+        t_w = get_time()
+        if warmup_rounds > 0:
+            try:
+                warm_dispatch(kfn, rem_fn)
+            except Exception as e:  # noqa: BLE001
+                if not using_aot:
+                    raise
+                # an AOT executable the runtime rejects must not kill
+                # the fit: rebuild through jit (persistent cache makes
+                # the recompile a read) and warm again
+                LOG("WARNING", "AOT round executable failed (%s: %s) — "
+                    "falling back to jit", type(e).__name__, e)
+                using_aot = False
+                kfn, rem_fn = kfn_jit, rem_jit
+                warm_dispatch(kfn, rem_fn)
         np.asarray(preds[:1])
-        self.last_warmup_seconds = get_time() - t_w
+        self.last_warm_dispatch_seconds = get_time() - t_w
+        self.last_warmup_seconds = join_wait + \
+            self.last_warm_dispatch_seconds
         if _metrics.enabled() and warmup_rounds > 0:
             gbt_metrics()["phase"].observe(self.last_warmup_seconds,
                                            engine="incore", phase="warmup")
@@ -621,6 +838,99 @@ class HistGBT(_ExternalMemoryEngine):
         return X, y, mask, n_pad
 
     # ------------------------------------------------------------------
+    # cold-start: overlapped compile + streamed ingest
+    # ------------------------------------------------------------------
+    def _maybe_start_warmup(self, n_features: int, n_padded: int,
+                            eval_every: int = 0
+                            ) -> Optional[_RoundProgramWarmup]:
+        """Kick off the round-program compiles in the background (see
+        :class:`_RoundProgramWarmup`); the handle parks on
+        ``self._pending_warmup`` for ``_boost_binned`` to join.
+
+        ``DMLC_COLDSTART_OVERLAP=0`` restores the serial pre-overlap
+        path exactly; multi-worker jobs stay serial too (a worker whose
+        compile thread races its peers' collective-ordered device_puts
+        is not worth the cold-start win there).  Never fatal — overlap
+        is an optimization, the inline path is the contract."""
+        if os.environ.get("DMLC_COLDSTART_OVERLAP", "1") == "0":
+            return None
+        from dmlc_core_tpu.parallel import collectives as coll
+        if coll.world_size() > 1 or self._mesh_spans_processes():
+            return None
+        try:
+            warm = _RoundProgramWarmup(self, n_features, n_padded,
+                                       eval_every)
+        except Exception as e:  # noqa: BLE001 — optimization, not contract
+            LOG("WARNING", "cold-start warmup kickoff failed "
+                "(%s: %s) — compiling inline", type(e).__name__, e)
+            return None
+        self._pending_warmup = warm
+        return warm
+
+    def _bin_ingest_streamed(self, X: np.ndarray,
+                             mat_sharding: NamedSharding) -> jax.Array:
+        """Chunked, double-buffered host→device ingest + binning.
+
+        The whole-matrix path ships the full f32 ``X`` to device and
+        keeps it resident while the bin kernel runs — ~5× the binned
+        matrix's HBM at peak.  Here rows stream in ``DMLC_INGEST_CHUNK_
+        ROWS`` slabs through a depth-2 pipe (the ``data/device_feed``
+        idiom): while chunk *i*'s bin+transpose kernel runs, chunk
+        *i+1*'s H2D copy is already in flight, and each f32 slab's last
+        reference drops as soon as its bins exist.  Peak residency: two
+        f32 slabs + ~2× the uint8 matrix (the concat transient).
+        Binning is per-element, so chunked output is bit-identical to
+        the whole-matrix path (pinned by tests/test_compile_cache.py).
+        """
+        n = X.shape[0]
+        ndev = int(np.prod([self.mesh.shape[a]
+                            for a in self.mesh.axis_names]))
+        chunk = _ingest_chunk_rows(ndev)
+        if chunk <= 0 or n <= chunk:
+            bins = self._bin_matrix(jax.device_put(X, mat_sharding))
+            # feature-major for the round program (see the host-bin
+            # branch comment in make_device_data); drop the row-major
+            # copy right away
+            bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
+            bins.delete()
+            del bins
+            return bins_t
+        fn = _bin_chunk_fn(self.mesh, self._missing, self._miss_bin())
+        pieces: List[jax.Array] = []
+        inflight: deque = deque()
+        for lo in range(0, n, chunk):
+            inflight.append(
+                jax.device_put(X[lo:lo + chunk], mat_sharding))
+            if len(inflight) >= 2:       # keep one H2D copy in flight
+                pieces.append(fn(inflight.popleft(), self.cuts))
+        while inflight:
+            pieces.append(fn(inflight.popleft(), self.cuts))
+        if len(pieces) == 1:
+            return pieces[0]
+        return _concat_feature_major_fn(self.mesh, len(pieces))(*pieces)
+
+    def _bin_eval_chunked(self, Xv: np.ndarray) -> jax.Array:
+        """Validation-set binning through the chunked ingest path: the
+        eval matrix streams device-ward slab by slab (double-buffered
+        like :meth:`_bin_ingest_streamed`) instead of one whole-matrix
+        ``jnp.asarray`` device_put, so a large eval_set never holds its
+        full f32 next to its bins."""
+        n = len(Xv)
+        chunk = _ingest_chunk_rows(1)
+        if chunk <= 0 or n <= chunk:
+            return self._bin_matrix(jnp.asarray(Xv))
+        pieces: List[jax.Array] = []
+        inflight: deque = deque()
+        for lo in range(0, n, chunk):
+            inflight.append(jnp.asarray(Xv[lo:lo + chunk]))
+            if len(inflight) >= 2:
+                pieces.append(self._bin_matrix(inflight.popleft()))
+        while inflight:
+            pieces.append(self._bin_matrix(inflight.popleft()))
+        return (pieces[0] if len(pieces) == 1
+                else jnp.concatenate(pieces, axis=0))
+
+    # ------------------------------------------------------------------
     # reusable device-resident training data (DMatrix analogy)
     # ------------------------------------------------------------------
     def make_device_data(
@@ -710,6 +1020,13 @@ class HistGBT(_ExternalMemoryEngine):
                  f"cuts width must be n_bins-{2 if self._missing else 1} "
                  f"for this model "
                  f"({'missing' if self._missing else 'standard'} mode)")
+        # every compile-time constant of the round program is now
+        # pinned (cuts mode, shapes, params) — start compiling it in
+        # the background so XLA works while the binning + H2D staging
+        # below runs (the cold-start overlap; _boost_binned joins)
+        ndev = int(np.prod([self.mesh.shape[a]
+                            for a in self.mesh.axis_names]))
+        self._maybe_start_warmup(F, n + ((-n) % ndev))
         X, y, mask, n_pad = self._pad_rows(X, y, weight)
 
         row_sharding = NamedSharding(self.mesh, P("data"))
@@ -735,16 +1052,14 @@ class HistGBT(_ExternalMemoryEngine):
                             missing=self._missing),
                 NamedSharding(self.mesh, P(None, "data")))
         else:
-            bins = self._bin_matrix(jax.device_put(X, mat_sharding))
             # the round program wants bins FEATURE-major ([F, n], rows on
             # lanes): the Pallas histogram kernel then reads its native
             # layout directly instead of re-transposing the matrix inside
             # every boosting round (a full HBM round-trip per round).
-            # Drop the row-major copy right away — keeping both layouts
-            # would double the binned matrix's HBM residency.
-            bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
-            bins.delete()
-            del bins
+            # Large inputs stream through the chunked double-buffered
+            # path so the full f32 matrix is never device-resident next
+            # to its uint8 bins (see _bin_ingest_streamed).
+            bins_t = self._bin_ingest_streamed(X, mat_sharding)
         out = {
             "bins_t": bins_t,
             "y_d": jax.device_put(y, row_sharding),
@@ -753,11 +1068,12 @@ class HistGBT(_ExternalMemoryEngine):
             "n_padded": n + n_pad,
             "n_features": F,
         }
+        # wall time of the whole quantize+stage pass (cuts, binning,
+        # H2D) — dispatch-async tail included only as far as the
+        # device_put calls themselves block
+        self.last_bin_seconds = get_time() - t_bin
         if _metrics.enabled():
-            # wall time of the whole quantize+stage pass (cuts, binning,
-            # H2D) — dispatch-async tail included only as far as the
-            # device_put calls themselves block
-            gbt_metrics()["phase"].observe(get_time() - t_bin,
+            gbt_metrics()["phase"].observe(self.last_bin_seconds,
                                            engine="incore", phase="bin")
         return out
 
@@ -791,6 +1107,13 @@ class HistGBT(_ExternalMemoryEngine):
         CHECK(not p.objective.startswith("rank:"),
               f"fit_device does not support {p.objective} (padded layout "
               "is per-fit); use fit(qid=...)")
+        if self._pending_warmup is None:
+            # no handle parked by make_device_data (or an earlier fit
+            # consumed it): compile kfn + rem_fn concurrently now — a
+            # warm _AOT_EXEC_CACHE makes this free, a warm persistent
+            # cache makes it a disk read
+            self._maybe_start_warmup(device_data["n_features"],
+                                     device_data["n_padded"])
         self.trees = []
         self.best_iteration = None
         self.best_score = None

@@ -194,9 +194,14 @@ class _ExternalMemoryEngine:
         it always uses the streaming engine's numpy draws, whatever the
         dataset size.
         """
+        from dmlc_core_tpu.base import compile_cache as _cc
         from dmlc_core_tpu.ops.quantile import SketchAccumulator
         from dmlc_core_tpu.parallel import collectives as coll
 
+        # the _ext_* jits (and the cached route's round program) all
+        # land in the persistent compile cache, so a relaunch — the
+        # elastic-recovery restart case — skips their compiles
+        _cc.configure()
         p = self.param
         CHECK(not (p.monotone_constraints
                    and any(int(v) for v in p.monotone_constraints)),
@@ -338,6 +343,10 @@ class _ExternalMemoryEngine:
         w = np.concatenate([pg["w"] for pg in pages])
         n = len(y)
         n_pad = (-n) % ndev
+        # overlap the round-program compile with the page concat +
+        # upload below (same handle fit()/fit_device use; see
+        # histgbt._RoundProgramWarmup — _boost_binned joins it)
+        self._maybe_start_warmup(F, n + n_pad)
         if isinstance(pages[0]["bins"], np.ndarray):
             # host pages (auto-residency route): concatenate on host so
             # the device sees ONE upload, not one per page — a remote

@@ -274,8 +274,12 @@ class SparseHistGBT:
         collectives must interleave with the level kernels), so it
         trades the fused-round dispatch amortization for scale-out.
         """
+        from dmlc_core_tpu.base import compile_cache as _cc
         from dmlc_core_tpu.parallel import collectives as coll
 
+        # persistent compile cache: a serve restart or repeat process
+        # re-reads this engine's programs instead of recompiling
+        _cc.configure()
         p = self.param
         offset, index, value = self._csr(offset, index, value)
         y = np.ascontiguousarray(y, np.float32)
@@ -316,16 +320,12 @@ class SparseHistGBT:
             self.cuts = merge_sparse_cut_candidates(gathered)
         else:
             self.cuts = build_sparse_cuts(index, value, F, p.n_bins)
-        gb = bin_sparse_entries(index, value, self.cuts)
-        rows = csr_rows(offset)
         TB = self.cuts.total_bins
         LOG("INFO", "SparseHistGBT: %d rows x %d features, %d nnz "
             "(density %.4f), %d ragged bins (dense would be %d)",
             n, F, len(index), len(index) / max(n * F, 1), TB,
             F * p.n_bins)
 
-        row_e = jnp.asarray(rows)
-        gb_e = jnp.asarray(gb)
         bin_ptr_d = jnp.asarray(self.cuts.bin_ptr)
         feat_of_bin_d = jnp.asarray(self.cuts.feat_of_bin)
         # each feature's LAST bin is not a threshold candidate
@@ -367,6 +367,41 @@ class SparseHistGBT:
                    gamma=p.gamma, mcw=p.min_child_weight,
                    alpha=p.reg_alpha, eta=p.learning_rate)
 
+        # cold-start overlap (doc/performance.md): every static of the
+        # round program is pinned the moment the cuts exist, but the
+        # heavy host pass — bin_sparse_entries searchsorting every nnz
+        # entry — hasn't run yet.  AOT-compile the K-round program on a
+        # background worker while that binning runs; join before the
+        # boosting loop.  DMLC_COLDSTART_OVERLAP=0 restores the serial
+        # path; compile failures fall back to the inline jit.
+        self.last_compile_seconds = None
+        warm_bg = warm_exec = None
+        warm_k = min(int(get_env("DMLC_TPU_SPARSE_ROUNDS_PER_DISPATCH",
+                                 8, int)), p.n_trees)
+        if (not distributed and p.subsample >= 1.0 and warm_k > 0
+                and get_env("DMLC_COLDSTART_OVERLAP", True, bool)):
+            nnz = len(index)
+            obj = self._obj
+
+            def _compile_rounds():
+                args = (jax.ShapeDtypeStruct((nnz,), jnp.int32),
+                        jax.ShapeDtypeStruct((nnz,), jnp.int32),
+                        y_d, w_d, preds, bin_ptr_d, feat_of_bin_d,
+                        last_mask, dense_pos_d)
+                return _sparse_rounds_k.lower(
+                    *args, k=warm_k, obj=obj, **cfg).compile()
+
+            warm_bg = _cc.BackgroundCompiler(
+                {"rounds_k": _compile_rounds}, what="sparse_round")
+
+        gb = bin_sparse_entries(index, value, self.cuts)
+        rows = csr_rows(offset)
+        row_e = jnp.asarray(rows)
+        gb_e = jnp.asarray(gb)
+        if warm_bg is not None:
+            warm_exec = warm_bg.join().get("rounds_k")
+            self.last_compile_seconds = warm_bg.compile_seconds
+
         def unpack(flat):
             self.trees.append({
                 "feat": flat[:d].astype(np.int32).reshape(depth, half),
@@ -391,10 +426,21 @@ class SparseHistGBT:
             done = 0
             while done < p.n_trees:
                 k = min(K, p.n_trees - done)
-                preds, flats = _sparse_rounds_k(
-                    row_e, gb_e, y_d, w_d, preds, bin_ptr_d,
-                    feat_of_bin_d, last_mask, dense_pos_d, k=k,
-                    obj=self._obj, **cfg)
+                dyn = (row_e, gb_e, y_d, w_d, preds, bin_ptr_d,
+                       feat_of_bin_d, last_mask, dense_pos_d)
+                if warm_exec is not None and k == warm_k:
+                    try:
+                        preds, flats = warm_exec(*dyn)
+                    except Exception as e:  # noqa: BLE001 — jit is truth
+                        LOG("WARNING", "sparse AOT executable failed "
+                            "(%s: %s) — falling back to jit",
+                            type(e).__name__, e)
+                        warm_exec = None
+                        preds, flats = _sparse_rounds_k(
+                            *dyn, k=k, obj=self._obj, **cfg)
+                else:
+                    preds, flats = _sparse_rounds_k(
+                        *dyn, k=k, obj=self._obj, **cfg)
                 for flat in np.asarray(flats):
                     unpack(flat)
                 done += k

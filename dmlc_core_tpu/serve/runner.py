@@ -28,15 +28,38 @@ Model families are adapted uniformly:
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import numpy as np
 
+from dmlc_core_tpu.base import compile_cache as _cc
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.parameter import get_env
+from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.serve.instruments import serve_metrics
 
 __all__ = ["ModelRunner"]
+
+
+def _infer_n_features(model: Any) -> Optional[int]:
+    """Feature width of a wrapped model, when its family exposes one —
+    what bucket pre-warm needs to synthesize zero batches.  sklearn
+    wrappers unwrap to their native engine first."""
+    inner = getattr(model, "model", None)
+    if inner is not None and hasattr(model, "_predict_native"):
+        model = inner
+    cuts = getattr(model, "cuts", None)            # HistGBT family
+    if cuts is not None and hasattr(cuts, "shape"):
+        return int(cuts.shape[0])
+    for attr in ("n_features", "_n_features"):     # sparse GBT, FM
+        v = getattr(model, attr, None)
+        if v:
+            return int(v)
+    w = getattr(model, "weights", None)            # GBLinear
+    if w is not None:
+        return int(np.asarray(w).shape[0])
+    return None
 
 
 def _is_pow2(n: int) -> bool:
@@ -96,7 +119,8 @@ class ModelRunner:
     """
 
     def __init__(self, model: Any, max_batch: int = 1024,
-                 min_bucket: int = 8, name: str = "default"):
+                 min_bucket: int = 8, name: str = "default",
+                 prewarm: Optional[bool] = None):
         CHECK(_is_pow2(max_batch),
               f"max_batch must be a power of two, got {max_batch}")
         CHECK(_is_pow2(min_bucket) and min_bucket <= max_batch,
@@ -108,10 +132,40 @@ class ModelRunner:
         #: metrics label — a role name, not a per-instance id
         self.name = name
         self._predict = _native_predict_fn(model)
+        self._n_features = _infer_n_features(model)
         #: bucket sizes whose shape has been executed (== compiled at
         #: least once by the model's jit cache) — the audit surface for
         #: the log2(max_batch)+1 compile bound
         self.compiled_shapes: set = set()
+        # persistent compile cache: a restarted server deserializes its
+        # bucket programs instead of recompiling them per bucket
+        _cc.configure()
+        if prewarm is None:
+            prewarm = get_env("DMLC_SERVE_PREWARM", False, bool)
+        if prewarm:
+            self.warmup()
+
+    def warmup(self, n_features: Optional[int] = None) -> float:
+        """Eagerly execute every ladder bucket on zero rows so the
+        first LIVE request per bucket doesn't eat that bucket's compile
+        (env-gate the constructor's call with ``DMLC_SERVE_PREWARM=1``
+        — registry-published runners inherit it).  Progress is visible
+        on the existing ``serve_compiled_shapes`` gauge, which reaches
+        ``shape_bound`` when the runner is fully warm.  Returns wall
+        seconds; with a warm persistent cache this is deserialize-only.
+        """
+        F = n_features or self._n_features
+        CHECK(F, f"ModelRunner.warmup: cannot infer n_features from "
+              f"{type(self.model).__name__} — pass n_features=")
+        t0 = get_time()
+        b = self.min_bucket
+        while b <= self.max_batch:
+            self._predict_bucket(np.zeros((b, F), np.float32))
+            b <<= 1
+        wall = get_time() - t0
+        LOG("INFO", "serve.runner %s: pre-warmed %d bucket shapes "
+            "in %.2fs", self.name, len(self.compiled_shapes), wall)
+        return wall
 
     @property
     def shape_bound(self) -> int:

@@ -33,6 +33,19 @@ if ! git diff --exit-code -- doc/api \
     exit 1
 fi
 
+echo "== compile cache (cold -> warm wiring) =="
+# two PROCESSES against one temp cache dir: the first must compile and
+# write (miss), the second must deserialize from disk (hit).  Guards
+# the persistent-cache wiring (config names, cache-key scheme, jax
+# monitoring event names) against jax-version drift — the cold-start
+# contract of doc/performance.md.
+CC_DIR="$(mktemp -d)"
+trap 'rm -rf "$CC_DIR"' EXIT
+env JAX_PLATFORMS=cpu DMLC_COMPILE_CACHE_DIR="$CC_DIR" \
+    DMLC_COMPILE_CACHE_EXPECT=miss python scripts/check_compile_cache.py
+env JAX_PLATFORMS=cpu DMLC_COMPILE_CACHE_DIR="$CC_DIR" \
+    DMLC_COMPILE_CACHE_EXPECT=hit python scripts/check_compile_cache.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"
