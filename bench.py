@@ -161,8 +161,17 @@ def _attach_metrics(out):
             m = snap.get(name)
             if m and m["series"]:
                 summary[field] = sum(s["value"] for s in m["series"])
-        if summary:
-            out["metrics_summary"] = summary
+        # resilience evidence rides every final record (zeros included):
+        # a perf run that silently degraded into a retry storm — or a
+        # chaos run that injected nothing — must be visible in the
+        # artifact, not only in a live scrape
+        for name, field in (("dmlc_retries_total", "retries_total"),
+                            ("dmlc_faults_injected_total",
+                             "faults_injected")):
+            m = snap.get(name)
+            summary[field] = (sum(s["value"] for s in m["series"])
+                              if m and m["series"] else 0.0)
+        out["metrics_summary"] = summary
     except Exception as e:  # noqa: BLE001
         out["metrics_error"] = f"{type(e).__name__}: {e}"[:200]
 

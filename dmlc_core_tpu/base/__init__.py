@@ -10,4 +10,9 @@ from dmlc_core_tpu.base.metrics import (  # noqa: F401
     MetricsRegistry,
     default_registry,
 )
+from dmlc_core_tpu.base.resilience import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+)
 from dmlc_core_tpu.base.thread_local import ThreadLocalStore  # noqa: F401

@@ -213,9 +213,17 @@ class MemoryFileSystem(FileSystem):
                 raise FileNotFoundError(f"mem://{key}")
             return MemoryStringStream(self._files[key])
         if mode == "w":
-            buf = bytearray()
-            self._files[key] = buf
-            return MemoryStringStream(buf)
+            # commit on close: a writer that dies (or aborts) mid-write
+            # must not have destroyed the previous object — the same
+            # atomicity the local backend gets from tmp + os.replace and
+            # remote backends from their commit-on-close uploads
+            files = self._files
+
+            class _MemCommitStream(MemoryStringStream):
+                def close(stream_self) -> None:  # noqa: N805
+                    files[key] = stream_self.data
+
+            return _MemCommitStream(bytearray())
         if mode == "a":
             buf = self._files.setdefault(key, bytearray())
             s = MemoryStringStream(buf)

@@ -42,9 +42,11 @@ class _GCSWriteStream(BufferedWriteStream):
     def _commit(self, data: bytes) -> None:
         url = (f"{self._fs._endpoint}/upload/storage/v1/b/{self._bucket}/o"
                f"?uploadType=media&name={urllib.parse.quote(self._obj, safe='')}")
+        # media upload replaces the whole object — retrying an ambiguous
+        # failure re-uploads the identical bytes, so opt in to retries
         http_request("POST", url,
                      self._fs._auth({"Content-Type": "application/octet-stream"}),
-                     data)
+                     data, idempotent=True)
 
 
 class GCSFileSystem(FileSystem):

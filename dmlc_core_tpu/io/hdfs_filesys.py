@@ -50,9 +50,15 @@ class _WebHDFSWriteStream(BufferedWriteStream):
         self._created = False
 
     def _two_step(self, method: str, op: str, data: bytes) -> None:
+        # retry safety per step: the namenode round trip only mints a
+        # redirect (no data applied) so it is retryable for any method;
+        # the datanode step inherits method semantics — CREATE is a PUT
+        # (idempotent, overwrite=true), APPEND is a POST and must NOT
+        # retry ambiguous transport failures (a double-append corrupts),
+        # though explicit 5xx rejections still retry.
         url = self._fs._op_url(self._path, op, self._host)
         status, hdrs, _ = http_request(method, url, follow_redirects=False,
-                                       ok=(200, 201, 307))
+                                       ok=(200, 201, 307), idempotent=True)
         if 300 <= status < 400:  # namenode redirects to a datanode
             url = hdrs["location"]
         http_request(method, url, {"Content-Type": "application/octet-stream"},

@@ -128,7 +128,9 @@ class _S3WriteStream(BufferedWriteStream):
 
     def _start_multipart(self) -> None:
         url = self._fs._object_url(self._bucket, self._key) + "?uploads="
-        _, _, body = self._fs._request("POST", url)
+        # initiate is retry-safe for ambiguous failures too: a duplicate
+        # initiate merely leaks an upload id S3 will age out
+        _, _, body = self._fs._request("POST", url, idempotent=True)
         self._upload_id = ET.fromstring(body).findtext(
             "{*}UploadId") or ET.fromstring(body).findtext("UploadId")
         CHECK(self._upload_id, "S3: no UploadId in InitiateMultipartUpload reply")
@@ -189,9 +191,9 @@ class S3FileSystem(FileSystem):
                              self._access, self._secret, self._region)
 
     def _request(self, method: str, url: str, headers: Optional[Dict[str, str]] = None,
-                 body: bytes = b""):
+                 body: bytes = b"", **kw):
         return http_request(method, url, self._sign(method, url, headers or {}, body),
-                            body)
+                            body, **kw)
 
     # -- FileSystem interface --------------------------------------------
     def open(self, uri: URI, mode: str) -> Stream:

@@ -31,10 +31,13 @@ starved — the infeed stall itself).  With host tracing on
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Generic, Iterator, Optional, TypeVar
 
+from dmlc_core_tpu.base import faultinject as _fi
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base.logging import LOG
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.io.concurrency import ConcurrentBlockingQueue, QueueKilled
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
@@ -75,6 +78,11 @@ def _iter_metrics():
             "items": r.counter(
                 "threaded_iter_items_total",
                 "items delivered to the consumer", labels=("iter",)),
+            "restarts": r.counter(
+                "threaded_iter_producer_restarts_total",
+                "producer exceptions absorbed by the bounded restart "
+                "budget instead of killing the pipeline",
+                labels=("iter",)),
         }
     return _M
 
@@ -100,11 +108,31 @@ class ThreadedIter(Generic[T]):
 
     Exceptions raised in the producer are captured and re-raised from
     ``next()`` in the consumer thread — the exception_ptr contract that the
-    reference's ``unittest_threaditer_exc_handling`` pins down.
+    reference's ``unittest_threaditer_exc_handling`` pins down.  With
+    ``max_restarts`` > 0 (or ``DMLC_ITER_PRODUCER_RESTARTS``) up to that
+    many producer exceptions are absorbed instead: the failed item is
+    skipped, the restart is counted, and the pipeline keeps flowing
+    (doc/robustness.md).
     """
 
-    def __init__(self, max_capacity: int = 8, name: str = "default"):
+    def __init__(self, max_capacity: int = 8, name: str = "default",
+                 max_restarts: Optional[int] = None):
         self.max_capacity = max_capacity
+        #: bounded producer-restart budget (whole iter lifetime): a
+        #: producer exception with budget left is logged, counted on
+        #: ``threaded_iter_producer_restarts_total`` and the producer
+        #: keeps going (the failed item is skipped) instead of killing
+        #: the pipeline.  Default 0 — every exception propagates to the
+        #: consumer exactly as before; env ``DMLC_ITER_PRODUCER_RESTARTS``
+        #: sets the process-wide default.
+        if max_restarts is None:
+            try:
+                max_restarts = int(
+                    os.environ.get("DMLC_ITER_PRODUCER_RESTARTS", "0"))
+            except ValueError:
+                max_restarts = 0
+        self.max_restarts = max_restarts
+        self._restarts_left = max_restarts
         #: metrics label — give pipelines distinct names so their
         #: queue-depth/stall series stay separable (bounded cardinality:
         #: use a role name, not a per-instance id)
@@ -151,12 +179,33 @@ class ThreadedIter(Generic[T]):
                     cell = self._free.pop(timeout=0.0) if self._free.size() else None
                 except (TimeoutError, QueueKilled):
                     cell = None
-                if tracing_enabled():
-                    with global_tracer().scope("threaded_iter.produce",
-                                               iter=self.name):
+                try:
+                    fault = _fi.check("iter", ctx=self.name)
+                    if fault is not None and fault.kind == "error":
+                        raise RuntimeError(
+                            f"fault injected: producer error ({self.name})")
+                    if tracing_enabled():
+                        with global_tracer().scope("threaded_iter.produce",
+                                                   iter=self.name):
+                            item = self._next_fn(cell)  # type: ignore[misc]
+                    else:
                         item = self._next_fn(cell)  # type: ignore[misc]
-                else:
-                    item = self._next_fn(cell)  # type: ignore[misc]
+                except QueueKilled:
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    if self._restarts_left <= 0:
+                        raise
+                    # bounded restart: absorb the failure, skip the item,
+                    # keep producing — the alternative is a dead pipeline
+                    # mid-epoch for a single flaky read
+                    self._restarts_left -= 1
+                    LOG("WARNING",
+                        "ThreadedIter %s: producer raised %s: %s — "
+                        "restarting (%d restarts left)", self.name,
+                        type(e).__name__, e, self._restarts_left)
+                    if _metrics.enabled():
+                        _iter_metrics()["restarts"].inc(1, iter=self.name)
+                    continue
                 if item is None:
                     self._full.push((epoch, _END))
                     # park until rewind or destroy

@@ -34,6 +34,7 @@ Quick start (see ``examples/serve_gbt.py`` and ``doc/serving.md``)::
 
 from dmlc_core_tpu.serve.batcher import (BatcherClosedError,  # noqa: F401
                                          DynamicBatcher, QueueFullError)
+from dmlc_core_tpu.serve.client import ResilientClient  # noqa: F401
 from dmlc_core_tpu.serve.frontend import ServeFrontend  # noqa: F401
 from dmlc_core_tpu.serve.instruments import serve_metrics  # noqa: F401
 from dmlc_core_tpu.serve.registry import (ModelRegistry,  # noqa: F401
@@ -44,5 +45,6 @@ from dmlc_core_tpu.serve.runner import ModelRunner  # noqa: F401
 __all__ = [
     "ModelRunner", "DynamicBatcher", "QueueFullError",
     "BatcherClosedError", "ModelRegistry", "checkpoint_model",
-    "load_model_checkpoint", "ServeFrontend", "serve_metrics",
+    "load_model_checkpoint", "ServeFrontend", "ResilientClient",
+    "serve_metrics",
 ]

@@ -1,0 +1,100 @@
+"""Resilient HTTP client for the serving frontend.
+
+The frontend already speaks admission control — a full queue or a
+fault answers **503 + Retry-After**, an expired request **504** — but
+PR 2 left every caller to hand-roll what to do about it.  This client
+closes the loop with the ``base.resilience`` layer:
+
+* retries through a :class:`~dmlc_core_tpu.base.resilience.RetryPolicy`
+  (env ``DMLC_RETRY_*``), honoring the frontend's ``Retry-After`` hint
+  via :class:`~dmlc_core_tpu.io.http_util.HttpError.retry_after` — a
+  503 shed is a *backpressure signal*, and the client is the half of
+  the contract that turns it into spaced-out retries instead of a
+  thundering herd;
+* optionally trips a :class:`~dmlc_core_tpu.base.resilience.
+  CircuitBreaker` so a hard-down frontend costs
+  :class:`~dmlc_core_tpu.base.resilience.CircuitOpenError` per call
+  (instant shed) instead of a full retry budget per call;
+* forwards an end-to-end deadline (``timeout_ms``) that the frontend
+  hands to the batcher, so a request that would expire in the queue is
+  **shed at batch-assembly time** (504) rather than executed late —
+  deadline shedding happens server-side where the queue wait is known.
+
+Predictions come back bit-identical to ``model.predict`` (JSON carries
+exact float32 values) — the property the chaos soak test pins down
+under active fault injection.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu.base.resilience import CircuitBreaker, RetryPolicy
+from dmlc_core_tpu.io.http_util import http_request
+
+__all__ = ["ResilientClient"]
+
+
+class ResilientClient:
+    """Retry/breaker-aware client for a :class:`~dmlc_core_tpu.serve.
+    frontend.ServeFrontend` (or anything speaking its HTTP/JSON API).
+
+    ``policy=None`` builds one from the ``DMLC_RETRY_*`` env knobs;
+    ``breaker`` is optional — pass a :class:`CircuitBreaker` to shed
+    instantly while the frontend is hard-down.
+    """
+
+    def __init__(self, base_url: str,
+                 policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.base_url = base_url.rstrip("/")
+        self._policy = policy if policy is not None else RetryPolicy.from_env()
+        self._breaker = breaker
+
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 op: str = "serve_request") -> Tuple[int, Dict[str, str], bytes]:
+        def once() -> Tuple[int, Dict[str, str], bytes]:
+            # predict is idempotent (pure function of the rows), so the
+            # POST may retry ambiguous transport failures too
+            return http_request(
+                method, self.base_url + path,
+                {"Content-Type": "application/json"} if body else None,
+                body, ok=(200,), retry=self._policy, idempotent=True, op=op)
+
+        if self._breaker is not None:
+            return self._breaker.call(once)
+        return once()
+
+    def predict(self, rows: Any,
+                timeout_ms: Optional[int] = None
+                ) -> Tuple[np.ndarray, int]:
+        """Score ``[k, F]`` rows (or one ``[F]`` row) →
+        ``(predictions, model_version)``.
+
+        ``timeout_ms`` rides in the request body as the end-to-end
+        deadline the frontend enforces: a request that would expire in
+        the batch queue is shed server-side (504 → retried here while
+        budget remains, then raised)."""
+        rows = np.asarray(rows, np.float32)
+        payload: Dict[str, Any] = {"rows": rows.tolist()}
+        if timeout_ms is not None:
+            payload["timeout_ms"] = int(timeout_ms)
+        _, _, body = self._request(
+            "POST", "/predict", json.dumps(payload).encode(),
+            op="serve_predict")
+        doc = json.loads(body)
+        return (np.asarray(doc["predictions"], np.float32),
+                int(doc["version"]))
+
+    def healthz(self) -> Dict[str, Any]:
+        """The frontend's liveness document (version, queue depth...)."""
+        _, _, body = self._request("GET", "/healthz", op="serve_healthz")
+        return json.loads(body)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition scraped from ``/metrics``."""
+        _, _, body = self._request("GET", "/metrics", op="serve_metrics")
+        return body.decode()

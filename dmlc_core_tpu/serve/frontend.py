@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from dmlc_core_tpu.base import faultinject as _fi
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG
 from dmlc_core_tpu.base.timer import get_time
@@ -231,6 +232,12 @@ class ServeFrontend:
 
     def _handle_predict(self, body: bytes
                         ) -> Tuple[int, Any, str, Dict[str, str]]:
+        fault = _fi.check("serve", ctx="/predict")
+        if fault is not None and fault.kind == "error":
+            # chaos drill: answer a shed exactly as admission control
+            # would, with an immediate-retry hint so drills stay fast
+            return (fault.int_value(503), {"error": "fault injected"},
+                    "application/json", {"Retry-After": "0"})
         if self.registry.current_version() is None:
             return (503, {"error": "no model published"},
                     "application/json", {"Retry-After": "1"})
@@ -245,13 +252,22 @@ class ServeFrontend:
                 raise ValueError(
                     f"too many rows in one request: {len(rows)} > "
                     f"max_batch {self._batcher.max_batch}")
+            # client-supplied end-to-end deadline: the batcher sheds a
+            # request whose deadline lapsed while it queued (504) instead
+            # of executing it late — see serve.client.ResilientClient
+            timeout = self.request_timeout
+            if "timeout_ms" in payload:
+                timeout_ms = float(payload["timeout_ms"])
+                if timeout_ms <= 0:
+                    raise ValueError(f"bad timeout_ms {timeout_ms}")
+                timeout = min(timeout, timeout_ms / 1000.0)
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
             return (400, {"error": f"bad request: {e}"},
                     "application/json", {})
         try:
-            fut = self._batcher.submit(rows, timeout=self.request_timeout)
-            preds, version = fut.result(timeout=self.request_timeout + 5.0)
+            fut = self._batcher.submit(rows, timeout=timeout)
+            preds, version = fut.result(timeout=timeout + 5.0)
         except QueueFullError:
             return (503, {"error": "queue full"},
                     "application/json", {"Retry-After": "1"})

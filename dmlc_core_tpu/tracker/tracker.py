@@ -17,12 +17,14 @@ bookkeeping on ssh clusters.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
+from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.parallel.collectives import get_link_map
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
@@ -59,8 +61,23 @@ def _worker_event(event: str, rank: int = -1) -> None:
 class RabitTracker:
     """Rank-assignment + topology service over TCP/JSON lines."""
 
-    def __init__(self, host_ip: str = "127.0.0.1", nworker: int = 1, port: int = 0):
+    def __init__(self, host_ip: str = "127.0.0.1", nworker: int = 1, port: int = 0,
+                 grace_s: Optional[float] = None):
         self.nworker = nworker
+        #: reconnect grace window (seconds).  A persistent worker whose
+        #: socket closes uncleanly is NOT declared dead immediately when
+        #: the window is > 0: its rank is reserved for ``grace_s`` so a
+        #: restarting worker can ``recover`` it (a pod reschedule, an ssh
+        #: blip).  Only when the window expires does the rank join the
+        #: free list and the death history.  Default 0 (immediate death,
+        #: the historical behavior); env ``DMLC_TRACKER_GRACE_S`` sets
+        #: the process-wide default.
+        if grace_s is None:
+            try:
+                grace_s = float(os.environ.get("DMLC_TRACKER_GRACE_S", "0"))
+            except ValueError:
+                grace_s = 0.0
+        self.grace_s = grace_s
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host_ip, port))
@@ -80,6 +97,7 @@ class RabitTracker:
         self._alive: Dict[int, socket.socket] = {}   # rank -> live conn
         self._free_ranks: List[int] = []             # ranks freed by death
         self.dead_workers: List[int] = []            # death history (ranks)
+        self._pending_death: Dict[int, float] = {}   # rank -> grace deadline
 
     # -- env ABI ---------------------------------------------------------
     def slave_envs(self) -> Dict[str, str]:
@@ -162,16 +180,47 @@ class RabitTracker:
             if _metrics.enabled():
                 _tracker_metrics()["alive"].set(len(self._alive))
             if not state["clean"]:
-                self.dead_workers.append(rank)
-                self._free_ranks.append(rank)
-                _worker_event("death", rank)
-                LOG("WARNING", "tracker: worker rank %d died (socket closed "
-                    "without shutdown); rank freed for recovery", rank)
+                if self.grace_s > 0:
+                    # reserve the rank: a reconnect inside the window is a
+                    # blip, not a death — the rank is handed out again only
+                    # after the grace deadline lapses (lazy expiry)
+                    self._pending_death[rank] = get_time() + self.grace_s
+                    _worker_event("lost", rank)
+                    LOG("WARNING", "tracker: worker rank %d lost (socket "
+                        "closed without shutdown); holding rank for %.1fs "
+                        "grace", rank, self.grace_s)
+                else:
+                    self.dead_workers.append(rank)
+                    self._free_ranks.append(rank)
+                    _worker_event("death", rank)
+                    LOG("WARNING", "tracker: worker rank %d died (socket closed "
+                        "without shutdown); rank freed for recovery", rank)
+
+    def _expire_graces_locked(self) -> None:
+        """Flush lapsed grace reservations into the death history + free
+        list.  Caller holds ``_lock``."""
+        if not self._pending_death:
+            return
+        now = get_time()
+        for rank in [r for r, t in self._pending_death.items() if t <= now]:
+            del self._pending_death[rank]
+            self.dead_workers.append(rank)
+            self._free_ranks.append(rank)
+            _worker_event("death", rank)
+            LOG("WARNING", "tracker: worker rank %d grace expired; rank "
+                "freed for recovery", rank)
 
     def alive_ranks(self) -> List[int]:
         """Ranks with a live persistent connection right now."""
         with self._lock:
             return sorted(self._alive)
+
+    def lost_ranks(self) -> List[int]:
+        """Ranks inside their reconnect grace window (reserved, not yet
+        declared dead)."""
+        with self._lock:
+            self._expire_graces_locked()
+            return sorted(self._pending_death)
 
     def _handle(self, msg: Dict[str, Any], conn: Optional[socket.socket] = None,
                 state: Optional[Dict[str, Any]] = None) -> Optional[Dict[str, Any]]:
@@ -191,6 +240,7 @@ class RabitTracker:
             return {"ok": True}
         if cmd in ("start", "recover"):
             with self._lock:
+                self._expire_graces_locked()
                 if cmd == "recover" and "rank" in msg and msg["rank"] >= 0:
                     rank = int(msg["rank"])  # rejoining worker keeps its rank
                 elif msg.get("host") and msg["host"] in self._host_rank and cmd == "recover":
@@ -201,9 +251,14 @@ class RabitTracker:
                     rank = self._next_rank
                     self._next_rank += 1
                 # the rank is now owned by this worker alone: it must not be
-                # handed out again via the free list or a stale host mapping
+                # handed out again via the free list, a stale host mapping,
+                # or a still-ticking grace reservation
                 if rank in self._free_ranks:
                     self._free_ranks.remove(rank)
+                if self._pending_death.pop(rank, None) is not None:
+                    _worker_event("reconnect", rank)
+                    LOG("INFO", "tracker: worker rank %d reconnected within "
+                        "the grace window", rank)
                 for h in [h for h, r in self._host_rank.items() if r == rank]:
                     del self._host_rank[h]
                 if msg.get("host"):

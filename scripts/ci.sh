@@ -46,6 +46,14 @@ env JAX_PLATFORMS=cpu DMLC_COMPILE_CACHE_DIR="$CC_DIR" \
 env JAX_PLATFORMS=cpu DMLC_COMPILE_CACHE_DIR="$CC_DIR" \
     DMLC_COMPILE_CACHE_EXPECT=hit python scripts/check_compile_cache.py
 
+echo "== resilience smoke (kill-and-recover + lossy wire) =="
+# deterministic fault-injection drills: SIGKILL a checkpoint writer
+# mid-write and prove the previous version survives bit-identically,
+# then push an S3 round-trip through injected 503s/truncations and
+# prove byte identity + retry/fault evidence on the metrics registry
+# (the doc/robustness.md contract).
+env JAX_PLATFORMS=cpu python scripts/check_resilience.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

@@ -10,12 +10,14 @@ reference left S3/HDFS untested in CI but we do better.
 import datetime
 import json
 import os
+import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+from dmlc_core_tpu.base.metrics import default_registry
 from dmlc_core_tpu.io.input_split import InputSplit
 from dmlc_core_tpu.io.recordio import encode_records
 from dmlc_core_tpu.io.s3_filesys import sigv4_headers
@@ -299,6 +301,77 @@ class _GCSFake(_FakeBase):
         self._send(400)
 
 
+def _flaky(handler_cls, every=3):
+    """Wrap a fake so every ``every``-th request fails first: writes get
+    a 503 + ``Retry-After: 0`` (server answered → not applied → any
+    method retries), reads rotate 503 / connection-reset-before-body /
+    connection-cut-mid-body (ambiguous transport failures only an
+    idempotent request may retry).  Deterministic: one shared counter."""
+    counter = {"n": 0}
+
+    class Flaky(handler_cls):
+        def _fault_due(self):
+            counter["n"] += 1
+            if counter["n"] % every == 0:
+                self.close_connection = True
+                return counter["n"] // every
+            return 0
+
+        def _reject(self):
+            self._send(503, b"busy", {"Retry-After": "0"})
+
+        def do_GET(self):  # noqa: N802
+            k = self._fault_due()
+            if not k:
+                super().do_GET()
+            elif k % 3 == 1:
+                self._reject()
+            elif k % 3 == 2:
+                # reset before any response bytes
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            else:
+                # connection cut mid-body: promise 64 bytes, send half
+                self.send_response(206)
+                self.send_header("Content-Length", "64")
+                self.end_headers()
+                self.wfile.write(b"x" * 32)
+                self.wfile.flush()
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+        def do_HEAD(self):  # noqa: N802
+            if self._fault_due():
+                self._reject()
+            else:
+                super().do_HEAD()
+
+        def do_PUT(self):  # noqa: N802
+            if self._fault_due():
+                self._body()  # drain, then reject without applying
+                self._reject()
+            else:
+                super().do_PUT()
+
+        def do_POST(self):  # noqa: N802
+            if self._fault_due():
+                self._body()
+                self._reject()
+            else:
+                super().do_POST()
+
+    return Flaky
+
+
+def _retries_total():
+    c = default_registry().counter("retries_total", labels=("op",))
+    return sum(s["value"] for s in c._snap())
+
+
 # ---------------------------------------------------------------------------
 # fixtures
 # ---------------------------------------------------------------------------
@@ -389,6 +462,83 @@ def test_gcs(serve, monkeypatch):
     endpoint = serve(_GCSFake, store)
     monkeypatch.setenv("GCS_ENDPOINT", endpoint)
     _roundtrip(lambda p: f"gs://bkt/{p}", monkeypatch)
+
+
+# ---------------------------------------------------------------------------
+# fault matrix: the same round trips over deliberately lossy fakes
+# ---------------------------------------------------------------------------
+
+def _fault_roundtrip(serve, monkeypatch, handler_cls, endpoint_var, uri_of):
+    """Full backend exercise against a flaky fake: results must be
+    byte-identical to the fault-free run and the retry layer must have
+    actually worked (nonzero ``dmlc_retries_total`` delta)."""
+    monkeypatch.setenv("DMLC_RETRY_BASE_S", "0.002")
+    monkeypatch.setenv("DMLC_RETRY_MAX_ATTEMPTS", "6")
+    store = {}
+    endpoint = serve(_flaky(handler_cls), store)
+    monkeypatch.setenv(endpoint_var, endpoint)
+    before = _retries_total()
+    _roundtrip(uri_of, monkeypatch)
+    assert _retries_total() > before, "flaky fake never triggered a retry"
+
+
+def test_s3_fault_matrix(serve, monkeypatch):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    _fault_roundtrip(serve, monkeypatch, _S3Fake, "S3_ENDPOINT",
+                     lambda p: f"s3://bkt/{p}")
+
+
+def test_hdfs_fault_matrix(serve, monkeypatch):
+    _fault_roundtrip(serve, monkeypatch, _HDFSFake, "DMLC_HDFS_NAMENODE",
+                     lambda p: f"hdfs:///{p}")
+
+
+def test_azure_fault_matrix(serve, monkeypatch):
+    _fault_roundtrip(serve, monkeypatch, _AzureFake, "AZURE_BLOB_ENDPOINT",
+                     lambda p: f"azure://ctr/{p}")
+
+
+def test_gcs_fault_matrix(serve, monkeypatch):
+    _fault_roundtrip(serve, monkeypatch, _GCSFake, "GCS_ENDPOINT",
+                     lambda p: f"gs://bkt/{p}")
+
+
+def test_s3_multipart_part_retry(serve, monkeypatch):
+    """Every few part PUTs are rejected with a 503 first; the per-part
+    retry must reassemble the exact object (no duplicated or dropped
+    parts)."""
+    monkeypatch.setenv("DMLC_RETRY_BASE_S", "0.002")
+    monkeypatch.setenv("DMLC_RETRY_MAX_ATTEMPTS", "6")
+    store = {}
+    endpoint = serve(_flaky(_S3Fake, every=2), store)
+    monkeypatch.setenv("S3_ENDPOINT", endpoint)
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    big = os.urandom(20 << 20)  # 3 parts at 8 MiB
+    before = _retries_total()
+    with Stream.create("s3://bkt/big.bin", "w") as s:
+        s.write(big)
+    assert store["bkt/big.bin"] == big
+    assert _retries_total() > before
+
+
+def test_client_side_fault_injection_roundtrip(serve, monkeypatch):
+    """The deterministic injector (http error/reset + stream truncate)
+    against a WELL-BEHAVED fake: byte-identical results, faults counted."""
+    from dmlc_core_tpu.base import faultinject as fi
+
+    monkeypatch.setenv("DMLC_RETRY_BASE_S", "0.002")
+    monkeypatch.setenv("DMLC_RETRY_MAX_ATTEMPTS", "8")
+    store = {}
+    endpoint = serve(_S3Fake, store)
+    monkeypatch.setenv("S3_ENDPOINT", endpoint)
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    payload = os.urandom(400_000)
+    with fi.inject("http:error=503:p=0.2,stream:truncate:p=0.3", seed=5):
+        with Stream.create("s3://bkt/f.bin", "w") as s:
+            s.write(payload)
+        with Stream.create("s3://bkt/f.bin", "r") as s:
+            assert s.read_all() == payload
+        assert fi.fired_total() > 0
 
 
 def test_write_aborts_on_exception(serve, monkeypatch):
