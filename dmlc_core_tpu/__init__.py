@@ -65,6 +65,15 @@ if _force_n and _force_n != "0":
 
     _force_cpu(int(_force_n))
 
+from dmlc_core_tpu.base import lockcheck as _lockcheck
+
+if _lockcheck.env_enabled():
+    # DMLC_LOCKCHECK=1: every threading.Lock/RLock created after this
+    # point participates in the cross-thread lock-order graph; cycles
+    # are reported via base.lockcheck.violations()/check() (see
+    # doc/static_analysis.md).
+    _lockcheck.install()
+
 from dmlc_core_tpu.base.logging import (  # noqa: F401
     Error,
     LOG,

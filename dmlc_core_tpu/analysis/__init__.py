@@ -1,0 +1,24 @@
+"""Project-aware static analysis (``scripts/dmlcheck.py``).
+
+The reference ships a real static-analysis layer — ``scripts/lint.py``
+cpplint/pylint wrappers plus CMake ``USE_SANITIZER`` race detection
+(SURVEY.md §2d/§5).  This package is that layer re-founded on ``ast``
+for the contracts THIS substrate actually breaks: lock discipline in
+thread-shared classes, purity of jit-traced functions, and the knob /
+metric registries.  One AST parse per file feeds every pass
+(:mod:`~dmlc_core_tpu.analysis.engine`); findings flow through a
+``# dmlcheck: off[:rule]`` suppression grammar and a committed baseline
+for grandfathered findings.  ``doc/static_analysis.md`` is the user
+guide; the dynamic counterpart of the lock pass lives in
+:mod:`dmlc_core_tpu.base.lockcheck`.
+"""
+
+from dmlc_core_tpu.analysis.engine import (
+    ALL_RULES, AnalysisContext, Finding, analyze, default_files,
+    load_baseline, write_baseline,
+)
+
+__all__ = [
+    "ALL_RULES", "AnalysisContext", "Finding", "analyze", "default_files",
+    "load_baseline", "write_baseline",
+]

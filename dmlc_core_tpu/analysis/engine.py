@@ -1,0 +1,300 @@
+"""dmlcheck core: file walker, suppression grammar, baseline, driver.
+
+Every pass shares ONE ``ast.parse`` per file (the walker parses up
+front; ``scripts/lint.py``'s former per-check re-parse is folded in
+here).  A pass is a function ``run(ctx)`` that reads ``ctx.files`` and
+calls ``ctx.add(...)``; the driver then applies suppressions and the
+baseline and reports what survives.
+
+Suppression grammar (checked against the finding's line):
+
+* ``# dmlcheck: off`` — trailing comment: suppress every rule on that
+  line; as a standalone comment within the first 10 lines of a file it
+  suppresses the whole file.
+* ``# dmlcheck: off:rule1[,rule2]`` — same scoping, named rules only.
+
+Baseline: a committed JSON file of finding *fingerprints* (no line
+numbers — fingerprints survive unrelated edits).  A finding whose
+fingerprint is baselined is reported as grandfathered, not a failure;
+stale entries (fingerprints that no longer fire) are surfaced so the
+baseline shrinks monotonically.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "ALL_RULES", "AnalysisContext", "Finding", "ParsedFile", "analyze",
+    "default_files", "load_baseline", "write_baseline",
+]
+
+#: every rule dmlcheck knows; ``--rules`` selects a subset
+ALL_RULES: Tuple[str, ...] = (
+    "syntax", "unused-import", "style",
+    "lock-discipline", "lock-release",
+    "jit-purity",
+    "knob-registry", "knob-doc",
+    "metric-registry", "metric-doc",
+)
+
+#: directories walked relative to the repo root (mirrors scripts/lint.py)
+PY_DIRS = ("dmlc_core_tpu", "tests", "scripts", "examples")
+CPP_DIRS = ("cpp",)
+ROOT_FILES = ("bench.py", "__graft_entry__.py", "dmlc-submit")
+
+_SUPPRESS_RE = re.compile(r"#\s*dmlcheck:\s*off(?::([A-Za-z0-9_,-]+))?")
+#: standalone suppression comments this early in the file scope the
+#: whole file instead of one line
+_FILE_SCOPE_LINES = 10
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One reported contract violation."""
+
+    path: str        # repo-relative, '/'-separated
+    line: int
+    rule: str
+    message: str
+    #: stable context (class.attr, knob name, ...) — line numbers drift,
+    #: fingerprints must not
+    key: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.key}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class ParsedFile:
+    """One walked file: source + (for Python) its single shared AST."""
+
+    def __init__(self, abspath: str, rel: str, kind: str) -> None:
+        self.abspath = abspath
+        self.rel = rel
+        self.kind = kind                      # "py" | "cpp"
+        with open(abspath, encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        if kind == "py":
+            try:
+                self.tree = ast.parse(self.src, filename=rel)
+            except SyntaxError as e:
+                self.syntax_error = e
+        # line -> suppressed rule names (empty set == all rules)
+        self.suppress: Dict[int, Set[str]] = {}
+        self.file_suppress: Optional[Set[str]] = None
+        self._scan_suppressions()
+
+    def _iter_comments(self):
+        """(lineno, comment_text, standalone) for every real comment —
+        tokenized for Python (a docstring describing the suppression
+        grammar must not trigger it), regex-per-line for C++."""
+        if self.kind == "py":
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.src).readline):
+                    if tok.type == tokenize.COMMENT:
+                        standalone = tok.line[:tok.start[1]].strip() == ""
+                        yield tok.start[0], tok.string, standalone
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                return
+        else:
+            for i, line in enumerate(self.lines, 1):
+                if "#" in line:
+                    idx = line.index("#")
+                    yield i, line[idx:], line[:idx].strip() == ""
+
+    def _scan_suppressions(self) -> None:
+        for i, comment, standalone in self._iter_comments():
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            rules = (set(m.group(1).split(",")) if m.group(1) else set())
+            bad = rules - set(ALL_RULES)
+            if bad:
+                # an unknown rule name silently suppressing nothing is
+                # worse than a loud config error
+                raise ValueError(
+                    f"{self.rel}:{i}: unknown dmlcheck rule(s) in "
+                    f"suppression: {sorted(bad)}")
+            if standalone and i <= _FILE_SCOPE_LINES:
+                if self.file_suppress is None:
+                    self.file_suppress = set()
+                if rules:
+                    self.file_suppress |= rules
+                else:
+                    self.file_suppress = set(ALL_RULES)
+            else:
+                cur = self.suppress.setdefault(i, set())
+                if rules:
+                    cur |= rules
+                elif not cur:
+                    self.suppress[i] = set(ALL_RULES)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if self.file_suppress is not None and (
+                not self.file_suppress or rule in self.file_suppress):
+            return True
+        rules = self.suppress.get(line)
+        return rules is not None and rule in rules
+
+
+@dataclass
+class AnalysisContext:
+    """What every pass sees: the parsed files plus repo-level inputs."""
+
+    root: str
+    files: List[ParsedFile]
+    #: declared knob names -> declaration line in base/knobs.py
+    knobs: Dict[str, int] = field(default_factory=dict)
+    knobs_rel: str = "dmlc_core_tpu/base/knobs.py"
+    #: doc-page name -> full text (knob/metric documentation checks)
+    docs: Dict[str, str] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+    suppressed_count: int = 0
+
+    def add(self, pf: ParsedFile, line: int, rule: str, message: str,
+            key: str) -> None:
+        if pf.suppressed(rule, line):
+            self.suppressed_count += 1
+            return
+        self.findings.append(Finding(pf.rel, line, rule, message, key))
+
+    def add_at(self, rel: str, line: int, rule: str, message: str,
+               key: str) -> None:
+        """Report against a path that may not be a walked file (e.g. a
+        missing doc page); no suppression applies."""
+        self.findings.append(Finding(rel, line, rule, message, key))
+
+
+def default_files(root: str) -> List[Tuple[str, str]]:
+    """(abspath, kind) for the repo's whole analyzable surface — the
+    same walk scripts/lint.py used, now shared by every pass."""
+    out: List[Tuple[str, str]] = []
+    for d in PY_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames.sort()
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append((os.path.join(dirpath, f), "py"))
+    for d in CPP_DIRS:
+        base = os.path.join(root, d)
+        if os.path.isdir(base):
+            for f in sorted(os.listdir(base)):
+                if f.endswith((".cc", ".h", ".cpp")):
+                    out.append((os.path.join(base, f), "cpp"))
+    for f in ROOT_FILES:
+        p = os.path.join(root, f)
+        if os.path.exists(p):
+            out.append((p, "py"))
+    return out
+
+
+def _load_knob_registry(root: str, rel: str) -> Dict[str, int]:
+    """Parse base/knobs.py statically (no import): every
+    ``declare("DMLC_X", ...)`` call is a registry entry."""
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=rel)
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "declare"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out[node.args[0].value] = node.lineno
+    return out
+
+
+def _load_docs(root: str) -> Dict[str, str]:
+    doc_dir = os.path.join(root, "doc")
+    out: Dict[str, str] = {}
+    if not os.path.isdir(doc_dir):
+        return out
+    for dirpath, dirnames, filenames in os.walk(doc_dir):
+        dirnames.sort()
+        for f in sorted(filenames):
+            if f.endswith(".md"):
+                p = os.path.join(dirpath, f)
+                with open(p, encoding="utf-8") as fh:
+                    out[os.path.relpath(p, root).replace(os.sep, "/")] = \
+                        fh.read()
+    return out
+
+
+def analyze(root: str,
+            files: Optional[Sequence[Tuple[str, str]]] = None,
+            rules: Optional[Sequence[str]] = None) -> AnalysisContext:
+    """Parse once, run the selected passes, return the context (findings
+    NOT yet baseline-filtered — the CLI owns that policy)."""
+    # late imports: engine <-> passes would otherwise cycle
+    from dmlc_core_tpu.analysis import jitpure, locks, registries, style
+
+    if files is None:
+        files = default_files(root)
+    selected = set(rules) if rules is not None else set(ALL_RULES)
+    bad = selected - set(ALL_RULES)
+    if bad:
+        raise ValueError(f"unknown dmlcheck rule(s): {sorted(bad)}")
+    parsed = [
+        ParsedFile(p, os.path.relpath(p, root).replace(os.sep, "/"), kind)
+        for p, kind in files
+    ]
+    ctx = AnalysisContext(root=root, files=parsed)
+    ctx.knobs = _load_knob_registry(root, ctx.knobs_rel)
+    ctx.docs = _load_docs(root)
+
+    if selected & {"syntax", "unused-import", "style"}:
+        style.run(ctx, selected)
+    if selected & {"lock-discipline", "lock-release"}:
+        locks.run(ctx, selected)
+    if "jit-purity" in selected:
+        jitpure.run(ctx)
+    if selected & {"knob-registry", "knob-doc", "metric-registry",
+                   "metric-doc"}:
+        registries.run(ctx, selected)
+    ctx.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return ctx
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> Set[str]:
+    """Grandfathered finding fingerprints from a baseline file (empty
+    set when the file does not exist)."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Persist ``findings`` as the new baseline (fingerprints only, so
+    entries survive line drift)."""
+    data = {
+        "comment": "dmlcheck grandfathered findings — shrink, never grow "
+                   "(see doc/static_analysis.md)",
+        "findings": sorted({f.fingerprint for f in findings}),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")

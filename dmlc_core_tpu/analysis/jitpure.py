@@ -1,0 +1,258 @@
+"""jit-purity pass: traced functions must be pure.
+
+A function handed to ``jax.jit`` (or AOT-compiled via
+``lower().compile()`` — the argument is still the jit call's) executes
+its Python body ONCE at trace time; anything environmental it reads is
+frozen into the executable and anything it mutates happens once, not
+per call.  Both are classic silent-wrongness bugs on a warm compile
+cache, where the trace may not re-run for days.
+
+Flagged inside a jitted function (and, transitively, every same-module
+function it calls):
+
+* ``os.environ`` / ``os.getenv`` reads — knob value baked at trace;
+* clock reads (``time.*``, ``get_time``) — timestamp baked at trace;
+* Python RNG (``random.*``) — one draw reused forever;
+* metrics-registry calls (``default_registry``, ``serve_metrics``,
+  ``*_metrics`` helpers, ``_metrics.*``) — a trace-time increment lies
+  about per-call behavior;
+* mutation of closed-over / global state (``global`` / ``nonlocal``
+  declarations, subscript stores or mutator-method calls on free
+  variables) — happens at trace, not per call.
+
+Detection of jit roots: ``@jax.jit`` / ``@jit`` decorators,
+``@partial(jax.jit, ...)``, and ``jax.jit(f)`` / ``jit(f)`` call sites
+where ``f`` is a lambda or a function defined in the same module.
+Resolution is same-module and name-based — cross-module roots are out
+of scope (each module's kernels live next to their jit wrapper in this
+repo).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext
+
+_METRIC_CALLS = {"default_registry", "serve_metrics"}
+_METRIC_MODULES = {"metrics", "_metrics"}
+#: NO "update"/"add" here (unlike the lock pass): ``tx.update(...)`` is
+#: optax's PURE gradient transform and jnp-style ``.add`` is functional
+#: — flagging them would condemn every optimizer step
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "clear",
+    "discard", "setdefault", "appendleft", "sort", "reverse",
+}
+_MAX_DEPTH = 24
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """``jax.jit`` or bare ``jit`` (however imported)."""
+    return ((isinstance(node, ast.Attribute) and node.attr == "jit")
+            or (isinstance(node, ast.Name) and node.id == "jit"))
+
+
+def _partial_jit(call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return (name == "partial" and bool(call.args)
+            and _is_jit_expr(call.args[0]))
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """name -> FunctionDef for every def in the module (nested included;
+    later definitions shadow earlier ones, matching runtime rebinding)."""
+
+    def __init__(self) -> None:
+        self.defs: Dict[str, ast.FunctionDef] = {}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.defs[node.name] = node
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def _jit_roots(tree: ast.AST, index: Dict[str, ast.FunctionDef]
+               ) -> List[Tuple[str, ast.AST]]:
+    """(display name, function node) for every traced function."""
+    roots: List[Tuple[str, ast.AST]] = []
+    seen: Set[int] = set()
+
+    def add(name: str, fn: ast.AST) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            roots.append((name, fn))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    add(node.name, node)
+                elif isinstance(dec, ast.Call) and (
+                        _is_jit_expr(dec.func) or _partial_jit(dec)):
+                    add(node.name, node)
+        elif (isinstance(node, ast.Call) and _is_jit_expr(node.func)
+              and node.args):
+            arg = node.args[0]
+            if isinstance(arg, ast.Lambda):
+                add(f"<lambda:L{arg.lineno}>", arg)
+            elif isinstance(arg, ast.Name) and arg.id in index:
+                add(arg.id, index[arg.id])
+    return roots
+
+
+class _Impurity:
+    __slots__ = ("line", "what", "key")
+
+    def __init__(self, line: int, what: str, key: str) -> None:
+        self.line = line
+        self.what = what
+        self.key = key
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Parameters + every name assigned within the function — anything
+    else referenced is free (closed-over or global)."""
+    bound: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for p in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)):
+            bound.add(p.arg)
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            bound.add(node.name)
+    return bound
+
+
+def _scan_body(fn: ast.AST, out: List[_Impurity]) -> Set[str]:
+    """Collect impurities in one function; return the names it calls
+    (for transitive same-module following)."""
+    bound = _bound_names(fn)
+    called: Set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Global):
+                out.append(_Impurity(
+                    node.lineno, "declares global "
+                    + ", ".join(node.names), "global"))
+            elif isinstance(node, ast.Nonlocal):
+                out.append(_Impurity(
+                    node.lineno, "declares nonlocal "
+                    + ", ".join(node.names), "nonlocal"))
+            elif isinstance(node, ast.Attribute):
+                base = node.value
+                if (isinstance(base, ast.Name) and base.id == "os"
+                        and node.attr in ("environ", "getenv")):
+                    out.append(_Impurity(
+                        node.lineno, f"reads os.{node.attr} at trace time",
+                        "os-environ"))
+                elif (isinstance(base, ast.Name) and base.id == "time"):
+                    out.append(_Impurity(
+                        node.lineno, f"reads the clock (time.{node.attr}) "
+                        "at trace time", "clock"))
+                elif (isinstance(base, ast.Name) and base.id == "random"):
+                    out.append(_Impurity(
+                        node.lineno, f"Python RNG (random.{node.attr}) "
+                        "draws once at trace time", "py-rng"))
+                elif (isinstance(base, ast.Name)
+                      and base.id in _METRIC_MODULES):
+                    out.append(_Impurity(
+                        node.lineno, f"touches the metrics registry "
+                        f"({base.id}.{node.attr}) at trace time",
+                        "metrics"))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name):
+                    called.add(f.id)
+                    if f.id == "get_time":
+                        out.append(_Impurity(
+                            node.lineno, "reads the clock (get_time) at "
+                            "trace time", "clock"))
+                    elif (f.id in _METRIC_CALLS
+                          or f.id.endswith("_metrics")):
+                        out.append(_Impurity(
+                            node.lineno, f"touches the metrics registry "
+                            f"({f.id}()) at trace time", "metrics"))
+                elif (isinstance(f, ast.Attribute)
+                      and f.attr in _MUTATORS
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id not in bound):
+                    out.append(_Impurity(
+                        node.lineno, f"mutates closed-over "
+                        f"{f.value.id!r} (.{f.attr}) at trace time",
+                        f"closure-mut:{f.value.id}"))
+            elif (isinstance(node, (ast.Assign, ast.AugAssign))
+                  or isinstance(node, ast.Delete)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [getattr(node, "target", None)]
+                           if not isinstance(node, ast.Delete)
+                           else node.targets)
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id not in bound):
+                        out.append(_Impurity(
+                            t.lineno, f"subscript-stores into closed-over "
+                            f"{t.value.id!r} at trace time",
+                            f"closure-mut:{t.value.id}"))
+    return called
+
+
+def _analyze_root(name: str, fn: ast.AST,
+                  index: Dict[str, ast.FunctionDef]
+                  ) -> List[Tuple[str, _Impurity]]:
+    """Scan ``fn`` and every same-module function it (transitively)
+    calls; impurities are attributed to the function they occur in."""
+    out: List[Tuple[str, _Impurity]] = []
+    visited: Set[str] = set()
+    frontier: List[Tuple[str, ast.AST]] = [(name, fn)]
+    depth = 0
+    while frontier and depth < _MAX_DEPTH:
+        depth += 1
+        nxt: List[Tuple[str, ast.AST]] = []
+        for fname, fnode in frontier:
+            if fname in visited:
+                continue
+            visited.add(fname)
+            imps: List[_Impurity] = []
+            called = _scan_body(fnode, imps)
+            out.extend((fname, i) for i in imps)
+            for c in called:
+                if c in index and c not in visited:
+                    nxt.append((c, index[c]))
+        frontier = nxt
+    return out
+
+
+def run(ctx: AnalysisContext) -> None:
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")):
+            continue
+        index_v = _FuncIndex()
+        index_v.visit(pf.tree)
+        index = index_v.defs
+        reported: Set[Tuple[str, str, int]] = set()
+        for root_name, fn in _jit_roots(pf.tree, index):
+            for where, imp in _analyze_root(root_name, fn, index):
+                dedup = (root_name, imp.key, imp.line)
+                if dedup in reported:
+                    continue
+                reported.add(dedup)
+                via = "" if where == root_name else f" (via {where})"
+                ctx.add(pf, imp.line, "jit-purity",
+                        f"jitted {root_name}{via} {imp.what}",
+                        key=f"{root_name}:{imp.key}")

@@ -1,0 +1,236 @@
+"""Lock-discipline pass: shared mutable state must stay behind the lock.
+
+Scope: classes in ``dmlc_core_tpu/`` that own a ``threading.Lock`` /
+``RLock`` / ``Condition`` attribute (the repo's convention for
+thread-shared objects — ThreadedIter, the serve batcher/registry, the
+tracker, metrics, resilience).  For each such class the pass computes,
+per ``self._*`` attribute:
+
+* **guarded** — accessed at least once inside ``with self.<lock>:``
+  (any of the class's lock attributes; a ``Condition`` built on the
+  class lock guards the same monitor);
+* **mutated after construction** — assigned / aug-assigned / subscript-
+  stored / mutator-method-called (``append``, ``pop``, ``update``, ...)
+  anywhere outside ``__init__``.
+
+An attribute that is BOTH is part of the class's locked state, and
+every access to it outside a ``with``-lock block (and outside
+``__init__``, which happens-before publication) is a ``lock-discipline``
+finding.  Attributes that are never locked anywhere are not flagged —
+the pass hunts *inconsistent* locking, which is how real races read,
+not lock-free designs.
+
+Convention: a method named ``*_locked`` asserts "caller holds the
+lock" and its body is treated as guarded (the tracker's
+``_expire_graces_locked`` pattern).
+
+``lock-release``: a bare ``x.acquire()`` statement must be immediately
+followed by ``try:`` whose ``finally:`` releases — anything else leaks
+the lock on the first exception.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "clear",
+    "update", "add", "discard", "setdefault", "appendleft", "sort",
+    "reverse",
+}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.expr) -> str:
+    """'x' for a ``self.x`` expression, else ''."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+class _Access:
+    __slots__ = ("attr", "line", "held", "in_init", "mutation", "method")
+
+    def __init__(self, attr: str, line: int, held: bool, in_init: bool,
+                 mutation: bool, method: str) -> None:
+        self.attr = attr
+        self.line = line
+        self.held = held
+        self.in_init = in_init
+        self.mutation = mutation
+        self.method = method
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Collect every ``self._*`` access in one method (nested closures
+    included — they run on whatever thread calls them)."""
+
+    def __init__(self, lock_attrs: Set[str], method: str) -> None:
+        self.lock_attrs = lock_attrs
+        self.method = method
+        self.in_init = method in ("__init__", "__new__")
+        # a *_locked method's whole body asserts the caller holds it
+        self.held_depth = 1 if method.endswith("_locked") else 0
+        self.accesses: List[_Access] = []
+
+    # -- guard tracking --------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        locks_here = sum(
+            1 for item in node.items
+            if _self_attr(item.context_expr) in self.lock_attrs)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held_depth += locks_here
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held_depth -= locks_here
+
+    # -- mutation forms --------------------------------------------------
+    def _note(self, attr: str, line: int, mutation: bool) -> None:
+        if attr and attr not in self.lock_attrs:
+            self.accesses.append(_Access(
+                attr, line, self.held_depth > 0, self.in_init, mutation,
+                self.method))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr:
+            self._note(attr, node.lineno,
+                       mutation=isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self._d[k] = v / del self._d[k] mutate the CONTAINER: the
+        # inner Attribute is a Load, so catch it here
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr(node.value)
+            if attr:
+                self._note(attr, node.lineno, mutation=True)
+                self.visit(node.slice)
+                return
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = _self_attr(node.target)
+        if attr:
+            self._note(attr, node.lineno, mutation=True)
+        elif (isinstance(node.target, ast.Subscript)
+              and _self_attr(node.target.value)):
+            self._note(_self_attr(node.target.value), node.lineno,
+                       mutation=True)
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS):
+            attr = _self_attr(node.func.value)
+            if attr:
+                self._note(attr, node.lineno, mutation=True)
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        self.generic_visit(node)
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    out.add(attr)
+    return out
+
+
+def _check_class(ctx: AnalysisContext, pf: ParsedFile,
+                 cls: ast.ClassDef) -> None:
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return
+    accesses: List[_Access] = []
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sc = _MethodScanner(lock_attrs, item.name)
+            for stmt in item.body:
+                sc.visit(stmt)
+            accesses.extend(sc.accesses)
+    guarded: Set[str] = set()
+    mutated_after_init: Set[str] = set()
+    for a in accesses:
+        if a.held:
+            guarded.add(a.attr)
+        if a.mutation and not a.in_init:
+            mutated_after_init.add(a.attr)
+    hot = guarded & mutated_after_init
+    seen: Set[Tuple[str, int]] = set()
+    for a in accesses:
+        if (a.attr in hot and not a.held and not a.in_init
+                and (a.attr, a.line) not in seen):
+            seen.add((a.attr, a.line))
+            ctx.add(pf, a.line, "lock-discipline",
+                    f"{cls.name}.{a.attr} is lock-guarded elsewhere but "
+                    f"accessed outside the lock in {a.method}()",
+                    key=f"{cls.name}.{a.attr}:{a.method}")
+
+
+def _check_acquire(ctx: AnalysisContext, pf: ParsedFile) -> None:
+    for node in ast.walk(pf.tree):
+        body_lists = [getattr(node, f, None)
+                      for f in ("body", "orelse", "finalbody")]
+        for stmts in body_lists:
+            if not isinstance(stmts, list):
+                continue
+            for i, stmt in enumerate(stmts):
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Call)
+                        and isinstance(stmt.value.func, ast.Attribute)
+                        and stmt.value.func.attr == "acquire"):
+                    continue
+                nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                released = (
+                    isinstance(nxt, ast.Try) and any(
+                        isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Call)
+                        and isinstance(s.value.func, ast.Attribute)
+                        and s.value.func.attr == "release"
+                        for fs in nxt.finalbody
+                        for s in ast.walk(fs)))
+                if not released:
+                    target = ast.unparse(stmt.value.func.value)
+                    ctx.add(pf, stmt.lineno, "lock-release",
+                            f"{target}.acquire() is not followed by "
+                            f"try/finally {target}.release() — the lock "
+                            f"leaks on the first exception",
+                            key=f"acquire:{target}")
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")):
+            continue
+        if "lock-discipline" in selected:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ClassDef):
+                    _check_class(ctx, pf, node)
+        if "lock-release" in selected:
+            _check_acquire(ctx, pf)

@@ -1,0 +1,168 @@
+"""Knob- and metric-registry passes.
+
+``knob-registry``: every literal ``DMLC_*`` string in non-test code
+must be declared in ``base/knobs.py`` (the central contract — see that
+module's docstring).  Literal matching deliberately catches more than
+``os.environ`` call sites: env names flow through helper constants
+(``faultinject._ENV_SPEC``), env-dict ABIs (the tracker's
+``slave_envs``) and ``get_env`` wrappers, and every one of those spells
+the knob as a full literal somewhere.
+
+``knob-doc``: every registry entry must appear somewhere under
+``doc/`` (``doc/configuration.md`` is generated from the registry, so
+this fails only when generation is skipped or a page regresses).
+
+``metric-registry``: every metric declaration (``.counter(name, help,
+labels=...)`` / ``.gauge`` / ``.histogram`` with a literal name) is
+collected repo-wide; the same ``dmlc_<name>`` declared twice with a
+different kind or label set is a collision the runtime registry would
+only catch when both modules happen to load.
+
+``metric-doc``: every declared metric's full name must appear in
+``doc/observability.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+
+_KNOB_RE = re.compile(r"^DMLC_[A-Z0-9_]+$")
+_METRIC_KINDS = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}
+#: the registry namespace ``MetricsRegistry.__init__`` prefixes
+_NAMESPACE = "dmlc"
+
+
+def _knob_scope(pf: ParsedFile) -> bool:
+    """Knob literals are enforced everywhere except tests (which invent
+    fake names on purpose) and the registry itself."""
+    return (pf.kind == "py" and pf.tree is not None
+            and not pf.rel.startswith("tests/"))
+
+
+def _check_knobs(ctx: AnalysisContext, selected: Set[str]) -> None:
+    doc_text = "\n".join(ctx.docs.values())
+    used: Set[str] = set()
+    for pf in ctx.files:
+        if not _knob_scope(pf) or pf.rel == ctx.knobs_rel:
+            continue
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _KNOB_RE.match(node.value)):
+                used.add(node.value)
+                if ("knob-registry" in selected
+                        and node.value not in ctx.knobs):
+                    ctx.add(pf, node.lineno, "knob-registry",
+                            f"env knob {node.value!r} is not declared in "
+                            f"base/knobs.py (name, default, doc line)",
+                            key=node.value)
+    if "knob-doc" in selected:
+        for name, line in sorted(ctx.knobs.items()):
+            if name not in doc_text:
+                ctx.add_at(ctx.knobs_rel, line, "knob-doc",
+                           f"knob {name!r} is declared but appears "
+                           f"nowhere under doc/ (regenerate "
+                           f"doc/configuration.md)", key=name)
+
+
+def _literal_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _literal_labels(node: ast.expr) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            s = _literal_str(e)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+class _MetricDecl:
+    __slots__ = ("name", "kind", "labels", "pf", "line")
+
+    def __init__(self, name: str, kind: str,
+                 labels: Optional[Tuple[str, ...]], pf: ParsedFile,
+                 line: int) -> None:
+        self.name = name
+        self.kind = kind
+        self.labels = labels
+        self.pf = pf
+        self.line = line
+
+
+def _metric_decls(pf: ParsedFile) -> List[_MetricDecl]:
+    out: List[_MetricDecl] = []
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_KINDS
+                and node.args):
+            continue
+        name = _literal_str(node.args[0])
+        if name is None:
+            continue
+        # a declaration carries a help string and/or metric kwargs; the
+        # profiler's Tracer.counter(name, value) event API does not
+        kw = {k.arg for k in node.keywords}
+        has_help = (len(node.args) >= 2
+                    and _literal_str(node.args[1]) is not None)
+        if not (has_help or kw & {"help", "labels", "buckets"}):
+            continue
+        labels: Optional[Tuple[str, ...]] = ()
+        for k in node.keywords:
+            if k.arg == "labels":
+                labels = _literal_labels(k.value)
+        if len(node.args) >= 3:
+            labels = _literal_labels(node.args[2])
+        full = (name if name.startswith(_NAMESPACE + "_")
+                else f"{_NAMESPACE}_{name}")
+        out.append(_MetricDecl(full, _METRIC_KINDS[node.func.attr],
+                               labels, pf, node.lineno))
+    return out
+
+
+def _check_metrics(ctx: AnalysisContext, selected: Set[str]) -> None:
+    decls: List[_MetricDecl] = []
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")):
+            continue
+        decls.extend(_metric_decls(pf))
+    by_name: Dict[str, _MetricDecl] = {}
+    obs = ctx.docs.get("doc/observability.md", "")
+    doc_reported: Set[str] = set()
+    for d in decls:
+        first = by_name.setdefault(d.name, d)
+        if ("metric-registry" in selected and first is not d
+                and (first.kind != d.kind
+                     or (first.labels is not None and d.labels is not None
+                         and first.labels != d.labels))):
+            ctx.add(d.pf, d.line, "metric-registry",
+                    f"metric {d.name!r} re-declared as {d.kind}"
+                    f"{list(d.labels or ())} — first declared as "
+                    f"{first.kind}{list(first.labels or ())} at "
+                    f"{first.pf.rel}:{first.line}", key=d.name)
+        if ("metric-doc" in selected and d.name not in obs
+                and d.name not in doc_reported):
+            doc_reported.add(d.name)
+            ctx.add(d.pf, d.line, "metric-doc",
+                    f"metric {d.name!r} is not documented in "
+                    f"doc/observability.md", key=d.name)
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    if selected & {"knob-registry", "knob-doc"}:
+        _check_knobs(ctx, selected)
+    if selected & {"metric-registry", "metric-doc"}:
+        _check_metrics(ctx, selected)

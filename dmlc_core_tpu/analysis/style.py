@@ -1,0 +1,90 @@
+"""Style + import hygiene pass (``scripts/lint.py`` folded in).
+
+Rules: ``syntax`` (file must parse), ``unused-import`` (names imported
+but never referenced; ``# noqa`` opts a line out, ``__init__.py``
+re-exports are exempt, ``__all__`` counts as use), ``style`` (trailing
+whitespace, tabs in Python indentation, lines > 100 columns).  C++
+files under ``cpp/`` get the ``style`` checks only.  The AST comes from
+the shared walker — one parse serves this pass and every other.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Set
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+
+MAX_LINE = 100
+
+
+class _ImportUse(ast.NodeVisitor):
+    """Imported names and every referenced name root."""
+
+    def __init__(self) -> None:
+        self.imports = {}     # name -> lineno
+        self.used: Set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports[(a.asname or a.name).split(".")[0]] = node.lineno
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name != "*":
+                self.imports[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+
+
+def _check_imports(ctx: AnalysisContext, pf: ParsedFile) -> None:
+    if os.path.basename(pf.rel) == "__init__.py":
+        return                       # packages import purely to re-export
+    v = _ImportUse()
+    v.visit(pf.tree)
+    exported: Set[str] = set()
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "__all__"
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            exported = {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)}
+    for name, lineno in sorted(v.imports.items(), key=lambda kv: kv[1]):
+        if name in v.used or name in exported:
+            continue
+        if lineno <= len(pf.lines) and "noqa" in pf.lines[lineno - 1]:
+            continue
+        ctx.add(pf, lineno, "unused-import",
+                f"unused import {name!r}", key=name)
+
+
+def _check_text(ctx: AnalysisContext, pf: ParsedFile) -> None:
+    for i, line in enumerate(pf.lines, 1):
+        if line != line.rstrip():
+            ctx.add(pf, i, "style", "trailing whitespace",
+                    key=f"ws:{i}")
+        if (pf.kind == "py"
+                and "\t" in line[:len(line) - len(line.lstrip())]):
+            ctx.add(pf, i, "style", "tab in indentation", key=f"tab:{i}")
+        if len(line) > MAX_LINE:
+            ctx.add(pf, i, "style",
+                    f"line longer than {MAX_LINE} columns ({len(line)})",
+                    key=f"len:{i}")
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    for pf in ctx.files:
+        if pf.kind == "py" and pf.syntax_error is not None:
+            if "syntax" in selected:
+                e = pf.syntax_error
+                ctx.add(pf, e.lineno or 1, "syntax",
+                        f"syntax error: {e.msg}", key=str(e.msg))
+        elif (pf.kind == "py" and "unused-import" in selected):
+            _check_imports(ctx, pf)
+        if "style" in selected:
+            _check_text(ctx, pf)

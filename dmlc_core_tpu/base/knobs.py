@@ -1,0 +1,237 @@
+"""Central registry of every ``DMLC_*`` environment knob.
+
+The reference scatters ``dmlc::GetEnv<T>`` reads across subsystems and
+documents them nowhere; after four PRs this substrate had grown ~40
+``DMLC_*`` reads with exactly the same drift.  This module is the single
+source of truth: every knob the codebase reads MUST be declared here
+(name, default, one-line doc), and ``scripts/dmlcheck.py``'s
+``knob-registry`` pass fails CI on any literal ``DMLC_*`` string in code
+that has no entry — plus any entry that never shows up under ``doc/``
+(``doc/configuration.md`` is generated from this registry by
+``scripts/gen_api_docs.py`` and gated stale-vs-committed in CI).
+
+Declaring a knob does not change how call sites read it (``os.environ``
+/ :func:`~dmlc_core_tpu.base.parameter.get_env` stay as they are); the
+registry is the contract layer, not a read path.  :func:`value` is
+provided for new call sites that want the declared default applied
+automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional
+
+__all__ = ["Knob", "declare", "get", "all_knobs", "names", "value"]
+
+
+class Knob(NamedTuple):
+    """One declared environment knob."""
+
+    #: full environment-variable name (``DMLC_...``)
+    name: str
+    #: default the reading call site applies when the var is unset
+    default: Any
+    #: one-line description (becomes the doc/configuration.md table row)
+    doc: str
+    #: subsystem bucket for the generated doc table ordering
+    group: str
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def declare(name: str, default: Any, doc: str, group: str = "misc") -> Knob:
+    """Register a knob; re-declaring with identical fields is a no-op,
+    conflicting re-declaration raises (same discipline as the metrics
+    registry)."""
+    if not name.startswith("DMLC_"):
+        raise ValueError(f"knob {name!r} must start with DMLC_")
+    existing = _REGISTRY.get(name)
+    k = Knob(name, default, doc, group)
+    if existing is not None:
+        if existing != k:
+            raise ValueError(f"knob {name!r} re-declared with different "
+                            f"fields: {existing} vs {k}")
+        return existing
+    _REGISTRY[name] = k
+    return k
+
+
+def get(name: str) -> Optional[Knob]:
+    """Look up a declared knob (None when unknown)."""
+    return _REGISTRY.get(name)
+
+
+def names() -> List[str]:
+    """All declared knob names, sorted."""
+    return sorted(_REGISTRY)
+
+
+def all_knobs() -> List[Knob]:
+    """All declared knobs, sorted by (group, name) — the order the
+    generated doc table uses."""
+    return sorted(_REGISTRY.values(), key=lambda k: (k.group, k.name))
+
+
+def value(name: str) -> Any:
+    """Read a declared knob from the environment with its declared
+    default applied (type inferred from the default, via
+    :func:`~dmlc_core_tpu.base.parameter.get_env`)."""
+    from dmlc_core_tpu.base.parameter import get_env
+
+    k = _REGISTRY.get(name)
+    if k is None:
+        raise KeyError(f"knob {name!r} is not declared in base/knobs.py")
+    return get_env(k.name, k.default)
+
+
+# ---------------------------------------------------------------------------
+# The declarations.  Grouped by subsystem; each ``doc`` line is exactly
+# what doc/configuration.md renders.  Defaults mirror the reading call
+# site — the knob-registry pass checks presence, the doc gate checks
+# documentation, and drift between this default and the call site's is a
+# review-visible diff in one place instead of a silent env archaeology.
+# ---------------------------------------------------------------------------
+
+# -- runtime / debugging ----------------------------------------------------
+declare("DMLC_TPU_FORCE_CPU", "",
+        "Force jax onto N host CPU devices before first backend init "
+        "(tests/CI); empty disables.", "runtime")
+declare("DMLC_TPU_NATIVE_LIB", "",
+        "Explicit path to the native helper shared library (overrides "
+        "the bundled lookup).", "runtime")
+declare("DMLC_TPU_NATIVE_IO", "1",
+        "0 disables the C fast paths (recordio/parsers/queues) in favor "
+        "of pure-Python fallbacks.", "runtime")
+declare("DMLC_TRACE", "0",
+        "1 enables the process-wide event Tracer "
+        "(utils/profiler.set_tracing).", "observability")
+declare("DMLC_METRICS", "1",
+        "0 disables the metrics registry: instruments become no-ops "
+        "(base/metrics).", "observability")
+declare("DMLC_METRICS_GBT_PHASES", "0",
+        "1 adds per-phase hist/split/leaf/apply timing in the external "
+        "GBT engine (adds device syncs).", "observability")
+declare("DMLC_DRYRUN_NESTED", "0",
+        "Internal recursion guard for the multichip dryrun harness "
+        "(__graft_entry__); not user-facing.", "runtime")
+declare("DMLC_LOCKCHECK", "0",
+        "1 installs the dynamic lock-order verifier at import: lock "
+        "acquisitions build a cross-thread order graph and cycles are "
+        "reported (base/lockcheck).", "observability")
+
+# -- GBT / compute ----------------------------------------------------------
+declare("DMLC_TPU_ROUNDS_PER_DISPATCH", 25,
+        "Boosting rounds fused per device dispatch in the dense "
+        "engine.", "gbt")
+declare("DMLC_TPU_SPARSE_ROUNDS_PER_DISPATCH", 8,
+        "Rounds fused per device dispatch in the sparse engine.", "gbt")
+declare("DMLC_TPU_FUSED_DESCEND", "0",
+        "1 selects the fused tree-descent prediction kernel "
+        "variant.", "gbt")
+declare("DMLC_TPU_BIN_BACKEND", "",
+        "'cpu' forces host-numpy feature binning; empty bins on "
+        "device.", "gbt")
+declare("DMLC_TPU_SKETCH_BACKEND", "",
+        "'cpu' forces the host quantile-sketch path; empty sketches on "
+        "device.", "gbt")
+declare("DMLC_TPU_EXTERNAL_DEVICE_BUDGET", 6 << 30,
+        "Device-memory budget in bytes for resident bin pages in the "
+        "external-memory engine.", "gbt")
+declare("DMLC_INGEST_CHUNK_ROWS", 2_000_000,
+        "Rows per double-buffered host-to-device ingest slab "
+        "(cold-start streaming).", "gbt")
+declare("DMLC_COLDSTART_OVERLAP", "1",
+        "0 restores the serial bin-then-compile cold start (no "
+        "ingest/compile overlap).", "gbt")
+
+# -- compile cache ----------------------------------------------------------
+declare("DMLC_COMPILE_CACHE", "1",
+        "0 disables the persistent compilation cache "
+        "(base/compile_cache).", "compile-cache")
+declare("DMLC_COMPILE_CACHE_DIR", "",
+        "Cache directory; empty adopts an already-configured dir or the "
+        "default location.", "compile-cache")
+declare("DMLC_COMPILE_CACHE_EXPECT", "",
+        "CI drill only: scripts/check_compile_cache.py asserts this "
+        "outcome ('miss' or 'hit').", "compile-cache")
+
+# -- io ---------------------------------------------------------------------
+declare("DMLC_HDFS_NAMENODE", "",
+        "Default namenode host:port for hdfs:// URIs "
+        "(WebHDFS).", "io")
+declare("DMLC_HDFS_USER", "$USER",
+        "WebHDFS user.name query parameter.", "io")
+declare("DMLC_IO_NO_ENDIAN_SWAP", "0",
+        "1 disables the endianness swap in the binary serializer "
+        "(big-endian hosts).", "io")
+declare("DMLC_ITER_PRODUCER_RESTARTS", 0,
+        "Process-wide default for ThreadedIter max_restarts (bounded "
+        "producer-exception absorption).", "io")
+
+# -- resilience -------------------------------------------------------------
+declare("DMLC_RETRY_MAX_ATTEMPTS", 4,
+        "RetryPolicy default attempt cap.", "resilience")
+declare("DMLC_RETRY_DEADLINE_S", 60.0,
+        "RetryPolicy default total-deadline seconds.", "resilience")
+declare("DMLC_RETRY_BASE_S", 0.05,
+        "RetryPolicy default base backoff seconds (exponential + full "
+        "jitter).", "resilience")
+declare("DMLC_RETRY_MAX_BACKOFF_S", 5.0,
+        "RetryPolicy default per-sleep backoff cap in "
+        "seconds.", "resilience")
+declare("DMLC_CB_THRESHOLD", 5,
+        "CircuitBreaker default consecutive-failure threshold before "
+        "opening.", "resilience")
+declare("DMLC_CB_RESET_S", 30.0,
+        "CircuitBreaker default open-to-half-open probe delay in "
+        "seconds.", "resilience")
+declare("DMLC_CKPT_KEEP", "",
+        "How many previous checkpoint versions to retain (.prev "
+        "chain); empty = 1.", "resilience")
+declare("DMLC_FAULT_INJECT", "",
+        "Deterministic fault-injection spec "
+        "('point:kind[=v][:p=][:n=][:after=];...'); empty "
+        "disables.", "resilience")
+declare("DMLC_FAULT_SEED", 1234,
+        "Seed for the per-rule fault-injection RNG streams.", "resilience")
+
+# -- serving ----------------------------------------------------------------
+declare("DMLC_SERVE_PREWARM", "0",
+        "1 pre-compiles the batch-bucket ladder at ModelRunner "
+        "construction (serve cold-start).", "serve")
+
+# -- distributed ABI (set by tracker/launchers, read by workers) ------------
+declare("DMLC_ROLE", "worker",
+        "Process role in a distributed job: worker / server / "
+        "scheduler.", "distributed")
+declare("DMLC_TRACKER_URI", "",
+        "Tracker host the worker handshakes with.", "distributed")
+declare("DMLC_TRACKER_PORT", "",
+        "Tracker TCP port.", "distributed")
+declare("DMLC_LEGACY_TRACKER_PORT", "",
+        "Port of the legacy one-shot tracker protocol (elastic-recovery "
+        "example ABI).", "distributed")
+declare("DMLC_NUM_WORKER", 1,
+        "Worker count the tracker coordinates.", "distributed")
+declare("DMLC_NUM_SERVER", 0,
+        "Parameter-server count (PS ABI only; the engine itself is the "
+        "KVStore shim).", "distributed")
+declare("DMLC_TASK_ID", 0,
+        "This worker's task index within the job.", "distributed")
+declare("DMLC_NUM_ATTEMPT", 0,
+        "Restart attempt number of this task (elastic "
+        "recovery).", "distributed")
+declare("DMLC_PS_ROOT_URI", "",
+        "PS scheduler host (PSTracker env ABI).", "distributed")
+declare("DMLC_PS_ROOT_PORT", "",
+        "PS scheduler port (PSTracker env ABI).", "distributed")
+declare("DMLC_WORKDIR", "",
+        "Remote working directory launchers cd into before exec'ing the "
+        "worker command.", "distributed")
+declare("DMLC_TRACKER_GRACE_S", 0.0,
+        "Reconnect grace window in seconds before a lost persistent "
+        "worker is declared dead.", "distributed")
+declare("DMLC_KVSTORE_CHECK", 0,
+        "1 enables out-of-mesh KVStore consistency checks (debug).",
+        "distributed")

@@ -205,7 +205,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
-        self._publish()
+        self._publish_locked()
 
     @classmethod
     def from_env(cls, name: str = "default", **overrides: Any
@@ -219,7 +219,9 @@ class CircuitBreaker:
         kw.update(overrides)
         return cls(name, **kw)
 
-    def _publish(self) -> None:
+    def _publish_locked(self) -> None:
+        """Export the state gauge; caller holds ``_lock`` (``__init__``
+        runs pre-publication, which is the same happens-before)."""
         if _metrics.enabled():
             _res_metrics()["circuit"].set(self._GAUGE[self._state],
                                           circuit=self.name)
@@ -228,21 +230,23 @@ class CircuitBreaker:
     def state(self) -> str:
         """Current state name (``closed`` / ``open`` / ``half_open``)."""
         with self._lock:
-            self._maybe_half_open()
+            self._maybe_half_open_locked()
             return self._state
 
-    def _maybe_half_open(self) -> None:
+    def _maybe_half_open_locked(self) -> None:
+        """Open -> half-open once the reset window lapses; caller holds
+        ``_lock``."""
         if (self._state == self.OPEN
                 and self._clock() - self._opened_at >= self.reset_timeout_s):
             self._state = self.HALF_OPEN
             self._probing = False
-            self._publish()
+            self._publish_locked()
 
     def allow(self) -> bool:
         """May a request proceed right now?  (half-open admits ONE
         probe; concurrent callers beyond it are shed)"""
         with self._lock:
-            self._maybe_half_open()
+            self._maybe_half_open_locked()
             if self._state == self.CLOSED:
                 return True
             if self._state == self.HALF_OPEN and not self._probing:
@@ -257,7 +261,7 @@ class CircuitBreaker:
             self._probing = False
             if self._state != self.CLOSED:
                 self._state = self.CLOSED
-                self._publish()
+                self._publish_locked()
                 LOG("INFO", "circuit %s: closed", self.name)
 
     def record_failure(self) -> None:
@@ -271,7 +275,7 @@ class CircuitBreaker:
             if tripped and self._state != self.OPEN:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
-                self._publish()
+                self._publish_locked()
                 if _metrics.enabled():
                     _res_metrics()["circuit_opens"].inc(1, circuit=self.name)
                 LOG("WARNING", "circuit %s: OPEN after %d failures "
@@ -284,8 +288,9 @@ class CircuitBreaker:
         """Run ``fn`` through the breaker: :class:`CircuitOpenError` when
         shedding, otherwise the call's own result/exception (recorded)."""
         if not self.allow():
+            # self.state (not ._state): the raw read raced record_*
             raise CircuitOpenError(
-                f"circuit {self.name!r} is {self._state}")
+                f"circuit {self.name!r} is {self.state}")
         try:
             out = fn()
         except BaseException:

@@ -46,7 +46,7 @@ class ConcurrentBlockingQueue(Generic[T]):
         self._not_full = threading.Condition(self._lock)
         self._killed = False
 
-    def _do_push(self, value: T, priority: int) -> None:
+    def _push_locked(self, value: T, priority: int) -> None:
         """Insert + notify; caller holds the lock and checked capacity."""
         if self._priority:
             heapq.heappush(self._items, (priority, self._seq, value))
@@ -55,7 +55,7 @@ class ConcurrentBlockingQueue(Generic[T]):
             self._items.append(value)
         self._not_empty.notify()
 
-    def _do_pop(self) -> T:
+    def _pop_locked(self) -> T:
         """Remove + notify; caller holds the lock and checked emptiness."""
         if self._priority:
             value = heapq.heappop(self._items)[2]
@@ -74,7 +74,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 self._not_full.wait(remaining)
             if self._killed:
                 raise QueueKilled()
-            self._do_push(value, priority)
+            self._push_locked(value, priority)
 
     def pop(self, timeout: Optional[float] = None) -> T:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -86,7 +86,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 self._not_empty.wait(remaining)
             if self._killed and not self._items:
                 raise QueueKilled()
-            return self._do_pop()
+            return self._pop_locked()
 
     def try_push(self, value: T, priority: int = 0) -> bool:
         """Non-blocking push; False when full (raises if killed)."""
@@ -95,7 +95,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 raise QueueKilled()
             if self._max > 0 and len(self._items) >= self._max:
                 return False
-            self._do_push(value, priority)
+            self._push_locked(value, priority)
             return True
 
     def try_pop(self) -> Tuple[bool, Optional[T]]:
@@ -105,7 +105,7 @@ class ConcurrentBlockingQueue(Generic[T]):
                 if self._killed:
                     raise QueueKilled()
                 return False, None
-            return True, self._do_pop()
+            return True, self._pop_locked()
 
     def signal_for_kill(self) -> None:
         with self._lock:
@@ -119,4 +119,5 @@ class ConcurrentBlockingQueue(Generic[T]):
 
     @property
     def killed(self) -> bool:
-        return self._killed
+        with self._lock:
+            return self._killed

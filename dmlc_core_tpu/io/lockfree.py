@@ -252,7 +252,8 @@ class Spinlock:
         if self._lib is not None:
             self._lib.dmlc_spinlock_lock(self._l)
         else:
-            self._pylock.acquire()
+            # this IS the lock primitive; callers own release pairing
+            self._pylock.acquire()  # dmlcheck: off:lock-release
 
     def try_acquire(self) -> bool:
         if self._lib is not None:
@@ -266,7 +267,7 @@ class Spinlock:
             self._pylock.release()
 
     def __enter__(self):
-        self.acquire()
+        self.acquire()  # dmlcheck: off:lock-release — paired by __exit__
         return self
 
     def __exit__(self, *exc):

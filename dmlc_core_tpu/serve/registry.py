@@ -175,14 +175,17 @@ class ModelRegistry:
         """The ``(version, runner)`` pair to execute a batch on.  Read
         once per batch: the tuple is immutable, so a concurrent publish
         cannot tear it and in-flight batches finish on what they saw."""
-        cur = self._current
+        # deliberate lock-free read: one atomic reference fetch of an
+        # immutable tuple (see class docstring) — a lock here would
+        # serialize every batch against publish
+        cur = self._current  # dmlcheck: off:lock-discipline
         CHECK(cur is not None,
               f"registry {self.name!r}: no model published")
         return cur
 
     def current_version(self) -> Optional[int]:
         """Current version number, or None before the first publish."""
-        cur = self._current
+        cur = self._current  # dmlcheck: off:lock-discipline (same as current())
         return None if cur is None else cur[0]
 
     def get(self, version: int) -> ModelRunner:

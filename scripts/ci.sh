@@ -18,18 +18,30 @@ echo "== hygiene =="
 # shadow the real package in tooling; keep only the native outputs
 rm -rf build/lib build/bdist.* ./*.egg-info
 
-echo "== lint =="
-python scripts/lint.py
+echo "== dmlcheck =="
+# project-aware static analysis (lock discipline, jit purity, knob /
+# metric registries, style) over one AST parse per file; runs in BOTH
+# lanes (quick included), budgeted <= 10s over the whole repo, and the
+# JSON report is archived like bench metrics.  doc/static_analysis.md
+# documents passes, suppressions and the baseline workflow.
+DMLCHECK_OUT="${DMLCHECK_OUT:-/tmp/dmlcheck.json}"
+t0=$SECONDS
+python scripts/dmlcheck.py --json "$DMLCHECK_OUT"
+if (( SECONDS - t0 > 10 )); then
+    echo "dmlcheck blew its 10s budget ($((SECONDS - t0))s)"
+    exit 1
+fi
 
 echo "== api docs =="
-# regenerate doc/api/ and FAIL on undocumented __all__ exports
-# (SURVEY.md §2d's generated-API-reference role); then fail if the
-# committed pages are stale vs the source
+# regenerate doc/api/ + doc/configuration.md (knob table from
+# base/knobs.py) and FAIL on undocumented __all__ exports (SURVEY.md
+# §2d's generated-API-reference role); then fail if the committed
+# pages are stale vs the source
 python scripts/gen_api_docs.py
 # modified pages AND brand-new untracked pages both fail the gate
-if ! git diff --exit-code -- doc/api \
-        || [[ -n "$(git status --porcelain -- doc/api)" ]]; then
-    echo "doc/api is stale: commit the regenerated pages"
+if ! git diff --exit-code -- doc/api doc/configuration.md \
+        || [[ -n "$(git status --porcelain -- doc/api doc/configuration.md)" ]]; then
+    echo "doc/api or doc/configuration.md is stale: commit the regenerated pages"
     exit 1
 fi
 

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""dmlcheck: project-aware static analysis over one AST parse per file.
+
+Passes (see doc/static_analysis.md for the catalog, suppression
+grammar and baseline workflow):
+
+* ``syntax`` / ``unused-import`` / ``style`` — the old scripts/lint.py,
+  folded into the shared walker;
+* ``lock-discipline`` / ``lock-release`` — shared mutable state outside
+  ``with self._lock``, and ``acquire()`` without try/finally;
+* ``jit-purity`` — env/clock/RNG/metrics/closure-mutation inside
+  jit-traced functions;
+* ``knob-registry`` / ``knob-doc`` — every ``DMLC_*`` literal declared
+  in base/knobs.py, every declaration documented under doc/;
+* ``metric-registry`` / ``metric-doc`` — unique (kind, label-set) per
+  ``dmlc_*`` metric name, all documented in doc/observability.md.
+
+Usage:
+    python scripts/dmlcheck.py                     # full run, baseline applied
+    python scripts/dmlcheck.py --rules style,jit-purity
+    python scripts/dmlcheck.py --json /tmp/dmlcheck.json
+    python scripts/dmlcheck.py --write-baseline    # grandfather current findings
+    python scripts/dmlcheck.py --no-baseline       # show baselined findings too
+
+Exit code 0 = no non-baselined findings; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from dmlc_core_tpu.analysis import (  # noqa: E402
+    ALL_RULES, analyze, load_baseline, write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(ROOT, "scripts", "dmlcheck_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset "
+                         f"(default: all of {', '.join(ALL_RULES)})")
+    ap.add_argument("--json", dest="json_out", default=None, metavar="PATH",
+                    help="write the machine-readable report here "
+                         "(archived by CI like bench metrics)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default scripts/"
+                         "dmlcheck_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--root", default=ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    rules = args.rules.split(",") if args.rules else None
+    t0 = time.perf_counter()
+    ctx = analyze(args.root, rules=rules)
+    elapsed = time.perf_counter() - t0
+
+    if args.write_baseline:
+        write_baseline(args.baseline, ctx.findings)
+        print(f"dmlcheck: baselined {len(ctx.findings)} finding(s) "
+              f"into {os.path.relpath(args.baseline, args.root)}")
+        return 0
+
+    baseline = (set() if args.no_baseline
+                else load_baseline(args.baseline))
+    live = [f for f in ctx.findings if f.fingerprint not in baseline]
+    grandfathered = len(ctx.findings) - len(live)
+    stale = baseline - {f.fingerprint for f in ctx.findings}
+
+    for f in live:
+        print(f.render())
+    if stale:
+        print(f"dmlcheck: note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed findings — "
+              "shrink the baseline):", file=sys.stderr)
+        for fp in sorted(stale):
+            print(f"  - {fp}", file=sys.stderr)
+    print(f"dmlcheck: {len(ctx.files)} files, "
+          f"{len(live)} finding(s), {grandfathered} baselined, "
+          f"{ctx.suppressed_count} suppressed, {elapsed:.2f}s",
+          file=sys.stderr)
+
+    if args.json_out:
+        report = {
+            "files_checked": len(ctx.files),
+            "elapsed_seconds": round(elapsed, 3),
+            "rules": list(rules) if rules else list(ALL_RULES),
+            "findings": [
+                {"path": f.path, "line": f.line, "rule": f.rule,
+                 "message": f.message, "fingerprint": f.fingerprint,
+                 "baselined": f.fingerprint in baseline}
+                for f in ctx.findings
+            ],
+            "suppressed": ctx.suppressed_count,
+            "stale_baseline": sorted(stale),
+        }
+        d = os.path.dirname(os.path.abspath(args.json_out))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+        print(f"dmlcheck: report -> {args.json_out}", file=sys.stderr)
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,137 +1,15 @@
 #!/usr/bin/env python
-"""Self-contained linter (stdlib only — this image ships no ruff/flake8).
-
-The reference ships ``scripts/lint.py`` as a cpplint wrapper plus pylint
-config (SURVEY.md §2d); this is the same role re-founded on ``ast`` so CI
-needs zero external tools.  Checks, per Python file:
-
-* parses (syntax);
-* no unused imports (names imported but never referenced — the check the
-  repo actually regresses on);
-* no tabs in indentation, no trailing whitespace;
-* line length ≤ 100 columns (repo style is ~79 soft, 100 hard).
-
-C++ files get the whitespace/length checks only.
-
-Exit code 0 = clean; 1 = findings (printed one per line as
-``path:line: message``).
+"""Back-compat shim: the old self-contained linter is now dmlcheck's
+``syntax`` / ``unused-import`` / ``style`` passes (one shared AST parse
+per file for every pass — see ``dmlc_core_tpu/analysis/`` and
+``doc/static_analysis.md``).  ``python scripts/lint.py`` keeps working
+and keeps meaning "style checks only"; CI runs the full analyzer via
+``python scripts/dmlcheck.py``.
 """
 
-from __future__ import annotations
-
-import ast
-import os
 import sys
 
-MAX_LINE = 100
-PY_DIRS = ("dmlc_core_tpu", "tests", "scripts", "examples")
-CPP_DIRS = ("cpp",)
-ROOT_FILES = ("bench.py", "__graft_entry__.py", "dmlc-submit")
-
-
-def iter_files():
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for d in PY_DIRS:
-        base = os.path.join(root, d)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            for f in sorted(filenames):
-                if f.endswith(".py"):
-                    yield os.path.join(dirpath, f), "py"
-    for d in CPP_DIRS:
-        base = os.path.join(root, d)
-        if os.path.isdir(base):
-            for f in sorted(os.listdir(base)):
-                if f.endswith((".cc", ".h", ".cpp")):
-                    yield os.path.join(base, f), "cpp"
-    for f in ROOT_FILES:
-        p = os.path.join(root, f)
-        if os.path.exists(p):
-            yield p, "py"
-
-
-class _ImportUse(ast.NodeVisitor):
-    """Collect imported names and every referenced name/attr root."""
-
-    def __init__(self):
-        self.imports = {}     # name -> (lineno, asname)
-        self.used = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imports[name] = node.lineno
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports[a.asname or a.name] = node.lineno
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def lint_python(path, src, out):
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        out.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
-        return
-    if os.path.basename(path) == "__init__.py":
-        return                       # packages import purely to re-export
-    v = _ImportUse()
-    v.visit(tree)
-    # a module re-exporting via __all__ counts as use; '# noqa' opts out
-    exported = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id == "__all__"
-                and isinstance(node.value, (ast.List, ast.Tuple))):
-            exported = {e.value for e in node.value.elts
-                        if isinstance(e, ast.Constant)}
-    lines = src.splitlines()
-    for name, lineno in sorted(v.imports.items(), key=lambda kv: kv[1]):
-        if name in v.used or name in exported:
-            continue
-        if lineno <= len(lines) and "noqa" in lines[lineno - 1]:
-            continue
-        out.append(f"{path}:{lineno}: unused import '{name}'")
-
-
-def lint_text(path, src, out, kind):
-    for i, line in enumerate(src.splitlines(), 1):
-        stripped = line.rstrip("\n")
-        if stripped != stripped.rstrip():
-            out.append(f"{path}:{i}: trailing whitespace")
-        if kind == "py" and stripped[:len(stripped) - len(stripped.lstrip())].count("\t"):
-            out.append(f"{path}:{i}: tab in indentation")
-        if len(stripped) > MAX_LINE:
-            out.append(f"{path}:{i}: line longer than {MAX_LINE} columns "
-                       f"({len(stripped)})")
-
-
-def main() -> int:
-    findings = []
-    n = 0
-    for path, kind in iter_files():
-        n += 1
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        if kind == "py":
-            lint_python(path, src, findings)
-        lint_text(path, src, findings, kind)
-    for f in findings:
-        print(f)
-    print(f"lint: {n} files checked, {len(findings)} finding(s)",
-          file=sys.stderr)
-    return 1 if findings else 0
-
-
 if __name__ == "__main__":
-    sys.exit(main())
+    from dmlcheck import main
+    sys.exit(main(["--rules", "syntax,unused-import,style"]
+                  + sys.argv[1:]))

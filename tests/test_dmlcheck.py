@@ -1,0 +1,491 @@
+"""dmlcheck (static analyzer) + lockcheck (dynamic verifier) contracts.
+
+Each static pass gets golden fixture snippets: at least one that MUST
+flag and one that must stay clean, so a pass that silently dies (or
+silently over-matches) fails here before it lies in CI.  Fixtures are
+written into a throwaway mini-repo layout (the walker scans the same
+directory names as the real one) — nothing is imported, only parsed.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu.analysis import analyze, load_baseline, write_baseline
+from dmlc_core_tpu.base import lockcheck
+
+
+def _mini_repo(tmp_path, files, docs=None, knobs=()):
+    """Lay out {relpath: source} plus an optional doc set and a knob
+    registry; returns the root to hand to analyze()."""
+    root = tmp_path / "repo"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    knob_lines = ["def declare(*a, **k):\n    pass\n"] + [
+        f'declare("{name}", "", "doc")\n' for name in knobs]
+    kp = root / "dmlc_core_tpu" / "base" / "knobs.py"
+    if not kp.exists():
+        kp.parent.mkdir(parents=True, exist_ok=True)
+        kp.write_text("".join(knob_lines))
+    for rel, text in (docs or {}).items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return str(root)
+
+
+def _findings(ctx, rule=None):
+    return [f for f in ctx.findings if rule is None or f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS_BAD = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, v):
+            with self._lock:
+                self._items.append(v)
+
+        def peek(self):
+            return self._items[-1]      # unguarded read of locked state
+"""
+
+_LOCKED_CLASS_GOOD = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._config = 3            # never locked -> never flagged
+
+        def add(self, v):
+            with self._lock:
+                self._items.append(v)
+
+        def peek(self):
+            with self._lock:
+                return self._items[-1]
+
+        def _drain_locked(self):
+            # *_locked convention: caller holds the lock
+            out = list(self._items)
+            self._items.clear()
+            return out
+
+        def scale(self):
+            return self._config * 2
+"""
+
+
+def test_lock_discipline_flags_unguarded_access(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _LOCKED_CLASS_BAD}),
+                  rules=["lock-discipline"])
+    got = _findings(ctx, "lock-discipline")
+    assert len(got) == 1 and "Shared._items" in got[0].message
+    assert got[0].key == "Shared._items:peek"
+
+
+def test_lock_discipline_clean_class_and_locked_convention(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _LOCKED_CLASS_GOOD}),
+                  rules=["lock-discipline"])
+    assert _findings(ctx) == []
+
+
+def test_lock_discipline_ignores_code_outside_package(tmp_path):
+    # the pass hunts product code, not test fixtures/scripts
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"scripts/tool.py": _LOCKED_CLASS_BAD}),
+                  rules=["lock-discipline"])
+    assert _findings(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-release
+# ---------------------------------------------------------------------------
+
+_ACQUIRE_BAD = """
+    import threading
+    _lk = threading.Lock()
+
+    def leaky():
+        _lk.acquire()
+        do_work()
+        _lk.release()
+"""
+
+_ACQUIRE_GOOD = """
+    import threading
+    _lk = threading.Lock()
+
+    def safe():
+        _lk.acquire()
+        try:
+            do_work()
+        finally:
+            _lk.release()
+"""
+
+
+def test_lock_release_flags_missing_try_finally(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _ACQUIRE_BAD}),
+                  rules=["lock-release"])
+    got = _findings(ctx, "lock-release")
+    assert len(got) == 1 and "try/finally" in got[0].message
+
+
+def test_lock_release_accepts_try_finally(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _ACQUIRE_GOOD}),
+                  rules=["lock-release"])
+    assert _findings(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+_JIT_BAD = """
+    import os
+    import time
+    import jax
+
+    def _helper(x):
+        return x * float(os.environ.get("SCALE", "1"))
+
+    @jax.jit
+    def kernel(x):
+        return _helper(x) + time.time()
+
+    _log = []
+
+    def stepper(x):
+        _log.append(1)
+        return x + 1
+
+    step = jax.jit(stepper)
+"""
+
+_JIT_GOOD = """
+    import os
+    import jax
+    import jax.numpy as jnp
+
+    CFG = float(os.environ.get("SCALE", "1"))   # read at import, fine
+
+    @jax.jit
+    def kernel(x):
+        def inner(c, v):
+            return c + v * CFG, None
+        total, _ = jax.lax.scan(inner, jnp.zeros(()), x)
+        return total
+"""
+
+
+def test_jit_purity_flags_env_clock_and_closure_mutation(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _JIT_BAD}),
+                  rules=["jit-purity"])
+    msgs = [f.message for f in _findings(ctx, "jit-purity")]
+    assert any("os.environ" in m and "via _helper" in m for m in msgs), msgs
+    assert any("clock" in m for m in msgs), msgs
+    assert any("mutates closed-over '_log'" in m for m in msgs), msgs
+
+
+def test_jit_purity_clean_kernel(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _JIT_GOOD}),
+                  rules=["jit-purity"])
+    assert _findings(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# knob registry
+# ---------------------------------------------------------------------------
+
+_KNOB_USE = """
+    import os
+    FLAG = os.environ.get("DMLC_FIXTURE_FLAG", "0")
+"""
+
+
+def test_knob_registry_flags_undeclared(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _KNOB_USE}),
+                  rules=["knob-registry"])
+    got = _findings(ctx, "knob-registry")
+    assert len(got) == 1 and got[0].key == "DMLC_FIXTURE_FLAG"
+
+
+def test_knob_registry_and_doc_clean_when_declared_and_documented(tmp_path):
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": _KNOB_USE},
+                      docs={"doc/configuration.md":
+                            "| `DMLC_FIXTURE_FLAG` | ... |\n"},
+                      knobs=["DMLC_FIXTURE_FLAG"])
+    ctx = analyze(root, rules=["knob-registry", "knob-doc"])
+    assert _findings(ctx) == []
+
+
+def test_knob_doc_flags_undocumented_declaration(tmp_path):
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": _KNOB_USE},
+                      knobs=["DMLC_FIXTURE_FLAG"])
+    ctx = analyze(root, rules=["knob-doc"])
+    got = _findings(ctx, "knob-doc")
+    assert len(got) == 1 and got[0].path.endswith("knobs.py")
+
+
+# ---------------------------------------------------------------------------
+# metric registry
+# ---------------------------------------------------------------------------
+
+_METRIC_A = """
+    def mod_metrics(r):
+        return r.counter("widget_total", "widgets", labels=("kind",))
+"""
+
+_METRIC_B_CONFLICT = """
+    def other_metrics(r):
+        return r.counter("widget_total", "widgets", labels=("color",))
+"""
+
+
+def test_metric_registry_flags_label_conflict(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "dmlc_core_tpu/a.py": _METRIC_A,
+        "dmlc_core_tpu/b.py": _METRIC_B_CONFLICT,
+    }, docs={"doc/observability.md": "`dmlc_widget_total`\n"})
+    ctx = analyze(root, rules=["metric-registry", "metric-doc"])
+    got = _findings(ctx, "metric-registry")
+    assert len(got) == 1 and "re-declared" in got[0].message
+    assert _findings(ctx, "metric-doc") == []
+
+
+def test_metric_registry_identical_redeclaration_ok_and_doc_flags(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "dmlc_core_tpu/a.py": _METRIC_A,
+        "dmlc_core_tpu/b.py": _METRIC_A.replace("mod_", "other_"),
+    })
+    ctx = analyze(root, rules=["metric-registry", "metric-doc"])
+    assert _findings(ctx, "metric-registry") == []
+    got = _findings(ctx, "metric-doc")
+    assert len(got) == 1 and got[0].key == "dmlc_widget_total"
+
+
+# ---------------------------------------------------------------------------
+# style / unused imports (the folded lint.py)
+# ---------------------------------------------------------------------------
+
+def test_style_and_unused_import(tmp_path):
+    src = ("import os\n"
+           "import sys  # noqa\n"
+           "X = 1   \n")
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["unused-import", "style", "syntax"])
+    rules = sorted(f.rule for f in ctx.findings)
+    assert rules == ["style", "unused-import"]   # noqa respected
+    assert any("trailing whitespace" in f.message for f in ctx.findings)
+
+
+def test_syntax_error_reported_not_crashed(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": "def broken(:\n"}))
+    got = _findings(ctx, "syntax")
+    assert len(got) == 1
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline round-trip
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    src = _LOCKED_CLASS_BAD.replace(
+        "return self._items[-1]      # unguarded read of locked state",
+        "return self._items[-1]  # dmlcheck: off:lock-discipline")
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["lock-discipline"])
+    assert _findings(ctx) == []
+    assert ctx.suppressed_count == 1
+
+
+def test_file_level_suppression(tmp_path):
+    src = "# dmlcheck: off\n" + textwrap.dedent(_LOCKED_CLASS_BAD)
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["lock-discipline"])
+    assert _findings(ctx) == [] and ctx.suppressed_count == 1
+
+
+def test_unknown_suppression_rule_is_loud(tmp_path):
+    src = "x = 1  # dmlcheck: off:not-a-rule\n"
+    with pytest.raises(ValueError, match="unknown dmlcheck rule"):
+        analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}))
+
+
+def test_docstring_mentioning_grammar_does_not_suppress(tmp_path):
+    src = '"""Docs: use ``# dmlcheck: off`` to suppress."""\n' \
+          + textwrap.dedent(_LOCKED_CLASS_BAD)
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["lock-discipline"])
+    assert len(_findings(ctx, "lock-discipline")) == 1
+
+
+def test_baseline_round_trip_and_line_drift(tmp_path):
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": _LOCKED_CLASS_BAD})
+    ctx = analyze(root, rules=["lock-discipline"])
+    assert len(ctx.findings) == 1
+    bp = str(tmp_path / "baseline.json")
+    write_baseline(bp, ctx.findings)
+    baseline = load_baseline(bp)
+    assert [f for f in ctx.findings
+            if f.fingerprint not in baseline] == []
+    # insert lines ABOVE the finding: lineno moves, fingerprint must not
+    mod = os.path.join(root, "dmlc_core_tpu", "mod.py")
+    with open(mod) as f:
+        drifted = "# a comment\n# another\n" + f.read()
+    with open(mod, "w") as f:
+        f.write(drifted)
+    ctx2 = analyze(root, rules=["lock-discipline"])
+    assert len(ctx2.findings) == 1
+    assert ctx2.findings[0].line != ctx.findings[0].line
+    assert ctx2.findings[0].fingerprint in baseline
+
+
+def test_repo_is_clean_under_committed_baseline():
+    """The acceptance gate itself: the real repo, the real baseline."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ctx = analyze(root)
+    baseline = load_baseline(
+        os.path.join(root, "scripts", "dmlcheck_baseline.json"))
+    live = [f for f in ctx.findings if f.fingerprint not in baseline]
+    assert live == [], "\n".join(f.render() for f in live)
+    # baseline discipline: base/, serve/, tracker/ must not be
+    # grandfathered — their findings get FIXED (ISSUE 5 satellite)
+    for fp in baseline:
+        assert not fp.startswith(("dmlc_core_tpu/base/",
+                                  "dmlc_core_tpu/serve/",
+                                  "dmlc_core_tpu/tracker/")), fp
+
+
+# ---------------------------------------------------------------------------
+# lockcheck: the dynamic side
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def traced():
+    installed_before = lockcheck.installed()
+    if not installed_before:
+        lockcheck.install()
+    yield
+    if not installed_before:
+        lockcheck.uninstall()
+    lockcheck.reset()
+
+
+def test_lockcheck_detects_inverted_pair(traced):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            time.sleep(0.005)
+            with b:
+                pass
+
+    def ba():
+        with b:
+            time.sleep(0.005)
+            with a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert lockcheck.violations(), "inverted lock order not detected"
+    with pytest.raises(lockcheck.LockOrderError):
+        lockcheck.check()
+
+
+def test_lockcheck_consistent_order_is_clean(traced):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    ts = [threading.Thread(target=ab) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert lockcheck.violations() == []
+    lockcheck.check()   # must not raise
+
+
+def test_lockcheck_condition_queue_integration(traced):
+    """Traced plain Locks must survive Condition wait/notify — the
+    ConcurrentBlockingQueue path every producer/consumer rides."""
+    from dmlc_core_tpu.io.concurrency import ConcurrentBlockingQueue
+
+    q = ConcurrentBlockingQueue(max_size=2)
+    got = []
+
+    def consumer():
+        for _ in range(20):
+            got.append(q.pop(timeout=5.0))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(20):
+        q.push(i, timeout=5.0)
+    t.join()
+    assert got == list(range(20))
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_rlock_condition_wait(traced):
+    """Default Condition() (RLock inside) exercises the
+    _release_save/_acquire_restore protocol on the traced wrapper."""
+    cond = threading.Condition()
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.02)
+    with cond:
+        ready.append(1)
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_env_gate(monkeypatch):
+    monkeypatch.setenv("DMLC_LOCKCHECK", "1")
+    assert lockcheck.env_enabled()
+    monkeypatch.setenv("DMLC_LOCKCHECK", "0")
+    assert not lockcheck.env_enabled()

@@ -506,10 +506,23 @@ def test_chaos_soak_train_and_serve():
     """Train HistGBT, serve it over HTTP with the ``serve`` fault point
     firing 503s, drive concurrent ResilientClients: every answered
     request must be bit-identical to ``model.predict`` (zero wrong
-    answers — retried/shed only), and the fault counter must be > 0."""
+    answers — retried/shed only), and the fault counter must be > 0.
+
+    The soak doubles as the validation workload for the dynamic
+    lock-order verifier (``base/lockcheck`` — what dmlcheck's static
+    ``lock-discipline`` pass claims, this observes): every lock created
+    during the run joins the cross-thread order graph, and the run must
+    finish with ZERO cycles.  ``DMLC_LOCKCHECK=1`` pre-installs the
+    verifier at import and widens coverage to import-time singletons;
+    otherwise it is installed here for the soak's duration."""
+    from dmlc_core_tpu.base import lockcheck
     from dmlc_core_tpu.models.histgbt import HistGBT
     from dmlc_core_tpu.serve import ModelRegistry, ResilientClient, \
         ServeFrontend
+
+    we_installed = not lockcheck.installed()
+    if we_installed:
+        lockcheck.install()
 
     rng = np.random.default_rng(0)
     X = rng.standard_normal((512, 8)).astype(np.float32)
@@ -556,6 +569,10 @@ def test_chaos_soak_train_and_serve():
                 t.join()
             faults = fi.fired_total()
 
+    if we_installed:
+        lockcheck.uninstall()
+    assert lockcheck.violations() == [], (
+        f"lock-order cycles under chaos: {lockcheck.violations()}")
     assert wrong == [], f"wrong answers under chaos: {wrong}"
     assert faults > 0, "chaos soak injected nothing"
     assert answered[0] > 0, "every request shed — retry layer is dead"
