@@ -627,6 +627,193 @@ def _serve_bench() -> None:
     }, final=True)
 
 
+def _stream_emit(rec, final=False):
+    rec = {"metric": "stream_staleness_seconds", "unit": "s",
+           "provisional": not final, **rec}
+    if final:
+        _attach_metrics(rec)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(rec) + "\n")
+        sys.stdout.flush()
+
+
+def _stream_bench() -> None:
+    """``--stream``: closed-loop online-learning benchmark.
+
+    A generator thread appends synthetic events (dense-event codec,
+    slight concept drift) to a growing RecordIO shard set at
+    ``STREAM_EVENTS_PER_SEC``; the main loop runs the full train→serve
+    path — tail → warm-start boost → eval-gate publish → registry
+    hot-swap — for ``STREAM_SECONDS``.  The headline is **staleness**:
+    the latency from an event being appended to an *activated* model
+    version having trained on it (p50/p95/p99 over all served events),
+    reported alongside refresh throughput.  ``--metrics-out`` archives
+    the full registry snapshot (tailer/trainer/publisher counters plus
+    the staleness histogram)."""
+    t0 = time.time()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
+    duration = min(float(os.environ.get("STREAM_SECONDS", 10)),
+                   max(budget - 120, 2.0))
+    rate = float(os.environ.get("STREAM_EVENTS_PER_SEC", 1500))
+    chunk_rows = int(os.environ.get("STREAM_CHUNK_ROWS", 1024))
+    window_chunks = int(os.environ.get("STREAM_WINDOW_CHUNKS", 2))
+    trees = int(os.environ.get("STREAM_TREES", 5))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+    shard_events = int(os.environ.get("STREAM_SHARD_EVENTS",
+                                      8 * chunk_rows))
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dmlc_core_tpu.utils import force_cpu_devices
+        force_cpu_devices(int(os.environ["BENCH_FORCE_CPU"]))
+
+    cfg = {"duration_s": duration, "events_per_sec": rate,
+           "chunk_rows": chunk_rows, "window_chunks": window_chunks,
+           "trees_per_refresh": trees, "features": feats}
+    _stream_emit({"value": 0.0, "phase": "setup", **cfg})
+
+    import shutil
+    import tempfile
+
+    import jax  # noqa: F401 — device init before timing anything
+
+    from dmlc_core_tpu.base.metrics import default_registry
+    from dmlc_core_tpu.io.recordio import encode_records
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.serve import ModelRegistry
+    from dmlc_core_tpu.stream import (ModelPublisher, OnlineTrainer,
+                                      RecordIOTailer, encode_dense_events)
+
+    stale_hist = default_registry().histogram(
+        "stream_staleness_seconds",
+        "event appended → servable prediction (an activated version "
+        "has trained on it)",
+        buckets=(0.25, 0.5, 1, 2, 4, 8, 16, 32, 64))
+
+    rng = np.random.default_rng(13)
+
+    def make_events(n, drift):
+        X = rng.normal(size=(n, feats)).astype(np.float32)
+        y = (X[:, 0] * X[:, 1] + (0.5 + drift) * X[:, 2]
+             - drift * X[:, 3] > 0).astype(np.float32)
+        return X, y
+
+    root = tempfile.mkdtemp(prefix="bench_stream_")
+    shard_dir = os.path.join(root, "events")
+    os.makedirs(shard_dir)
+    append_ts = []                    # wall clock per appended event seq
+    stop_gen = threading.Event()
+
+    def generator():
+        """Paced appender: bursts every tick, fsync-free flush so the
+        tailer sees bytes promptly; rotates shards so the tailer's
+        growing-file-set path is exercised."""
+        written = 0
+        shard_idx = 0
+        f = open(os.path.join(shard_dir, f"part-{shard_idx:04d}.rec"), "ab")
+        start = time.perf_counter()
+        try:
+            while not stop_gen.is_set():
+                target = int((time.perf_counter() - start) * rate)
+                burst = min(target - written, 4096)
+                if burst <= 0:
+                    time.sleep(0.01)
+                    continue
+                drift = 0.2 * ((written // shard_events) % 3)
+                X, y = make_events(burst, drift)
+                blob = encode_records(encode_dense_events(X, y))
+                f.write(blob)
+                f.flush()
+                now = time.time()
+                append_ts.extend([now] * burst)
+                written += burst
+                if written // shard_events > shard_idx:
+                    f.close()
+                    shard_idx = written // shard_events
+                    f = open(os.path.join(
+                        shard_dir, f"part-{shard_idx:04d}.rec"), "ab")
+        finally:
+            f.close()
+
+    Xh, yh = make_events(4096, drift=0.0)
+    registry = ModelRegistry(max_batch=256, min_bucket=8)
+    publisher = ModelPublisher(registry, holdout=(Xh, yh),
+                               name="stream-bench")
+    model = HistGBT(n_trees=trees, max_depth=4, n_bins=32,
+                    learning_rate=0.3)
+    tailer = RecordIOTailer(shard_dir,
+                            cursor_uri=os.path.join(root, "cursor.ckpt"),
+                            name="stream-bench")
+    trainer = OnlineTrainer(model, tailer, n_features=feats,
+                            chunk_rows=chunk_rows,
+                            window_chunks=window_chunks, decay=1.0,
+                            publisher=publisher, name="stream-bench")
+
+    gen = threading.Thread(target=generator, daemon=True)
+    gen.start()
+    _stream_emit({"value": 0.0, "phase": "loop", **cfg})
+
+    staleness = []
+    served_floor = 0                  # events covered by an activation
+    refreshes = []
+    end = time.perf_counter() + duration
+    try:
+        while time.perf_counter() < end:
+            left = end - time.perf_counter()
+            r = trainer.refresh(timeout=max(min(left, 5.0), 0.1))
+            if r is None:
+                continue
+            refreshes.append(r)
+            if r.get("activated"):
+                now = time.time()
+                covered = min(r["records_total"], len(append_ts))
+                for seq in range(served_floor, covered):
+                    s = now - append_ts[seq]
+                    staleness.append(s)
+                    stale_hist.observe(s)
+                served_floor = covered
+    finally:
+        stop_gen.set()
+        gen.join(timeout=5.0)
+        tailer.close()
+
+    wall = time.time() - t0
+    activated = sum(1 for r in refreshes if r.get("activated"))
+    stale_sorted = sorted(staleness)
+
+    def q(p):
+        if not stale_sorted:
+            return None
+        return round(stale_sorted[min(len(stale_sorted) - 1,
+                                      int(round(p * (len(stale_sorted)
+                                                     - 1))))], 3)
+
+    fit_s = [r["fit_seconds"] for r in refreshes]
+    final = {
+        "value": q(0.95) or 0.0,
+        "phase": "done",
+        "elapsed_s": round(wall, 1),
+        "platform": jax.devices()[0].platform,
+        "staleness_seconds": {"p50": q(0.50), "p95": q(0.95),
+                              "p99": q(0.99)},
+        "refreshes_published": activated,
+        "refreshes_total": len(refreshes),
+        "rollbacks": publisher.rollbacks,
+        "refreshes_per_sec": round(len(refreshes) / max(duration, 1e-9), 3),
+        "refresh_rows_per_sec": round(
+            sum(r["rows"] for r in refreshes) / max(duration, 1e-9), 1),
+        "fit_seconds_mean": (round(sum(fit_s) / len(fit_s), 3)
+                             if fit_s else None),
+        "events_appended": len(append_ts),
+        "events_consumed": tailer.records_seen,
+        "events_served": served_floor,
+        "trees_total": len(model.trees),
+        "registry_versions": len(registry.versions()),
+        **cfg,
+    }
+    _stream_emit(final, final=True)
+    shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> None:
     EV["t0"] = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
@@ -861,5 +1048,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         _serve_bench()
+    elif "--stream" in sys.argv:
+        _stream_bench()
     else:
         main()

@@ -201,6 +201,29 @@ declare("DMLC_SERVE_PREWARM", "0",
         "1 pre-compiles the batch-bucket ladder at ModelRunner "
         "construction (serve cold-start).", "serve")
 
+# -- streaming / online learning --------------------------------------------
+declare("DMLC_STREAM_POLL_S", 0.05,
+        "Tailer base poll interval in seconds; idle polls back off "
+        "exponentially (with jitter) from here.", "stream")
+declare("DMLC_STREAM_MAX_BACKOFF_S", 1.0,
+        "Cap on the tailer's jittered idle-poll backoff in "
+        "seconds.", "stream")
+declare("DMLC_STREAM_CURSOR", "",
+        "Default cursor checkpoint URI for RecordIOTailer.commit "
+        "(crash-safe resume); empty = no default.", "stream")
+declare("DMLC_STREAM_CHUNK_ROWS", 2048,
+        "Fresh event rows gathered per online-trainer refresh.", "stream")
+declare("DMLC_STREAM_WINDOW_CHUNKS", 4,
+        "Sliding training window length in chunks; steady-state window "
+        "row count (and compiled shapes) stay fixed once full.", "stream")
+declare("DMLC_STREAM_DECAY", 1.0,
+        "Per-chunk-age sample-weight decay in (0, 1]; 1.0 = pure "
+        "sliding window (no weights, warm-start parity).", "stream")
+declare("DMLC_STREAM_EVAL_GATE", 0.1,
+        "Publisher eval-gate relative tolerance: a refresh is rejected "
+        "when holdout score exceeds the active version's by more than "
+        "this fraction.", "stream")
+
 # -- distributed ABI (set by tracker/launchers, read by workers) ------------
 declare("DMLC_ROLE", "worker",
         "Process role in a distributed job: worker / server / "

@@ -18,10 +18,7 @@ from __future__ import annotations
 import contextlib
 from typing import Iterator, Optional
 
-import numpy as np
-
 from dmlc_core_tpu.base import metrics as _metrics
-from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.data.parsers import Parser, parse_uri_spec
 from dmlc_core_tpu.data.row_block import RowBlock, RowBlockContainer
@@ -249,6 +246,11 @@ def iter_dense_slabs(row_iter, num_col: int, batch_rows: int):
     streaming fit/predict (GBLinear.fit_iter, HistGBT.predict_iter,
     GBLinear.predict_iter).
 
+    Since the ``stream.dataset`` refactor this is a thin adapter over
+    the shared :class:`~dmlc_core_tpu.stream.dataset.Dataset`
+    abstraction (``Dataset.from_row_iter(...).dense_slabs(...)``) —
+    batch and online paths stage slabs through one implementation.
+
     CSR pages densify straight into one reused staging buffer; pages
     straddling a slab boundary split transparently (RowBlock.slice row
     ranges).  Host memory stays bounded by one slab regardless of the
@@ -260,30 +262,7 @@ def iter_dense_slabs(row_iter, num_col: int, batch_rows: int):
     copy (or upload with an explicit host copy) before advancing the
     generator.  ``w`` is 1.0 where the page carries no weights.
     """
-    CHECK(batch_rows > 0, f"iter_dense_slabs: batch_rows must be "
-                          f"positive, got {batch_rows}")
-    stage = np.empty((batch_rows, num_col), np.float32)
-    ys = np.empty(batch_rows, np.float32)
-    ws = np.empty(batch_rows, np.float32)
-    filled = 0
-    for b in row_iter:
-        CHECK(b.nnz == 0 or b.max_index < num_col,
-              f"iter_dense_slabs: page has feature index {b.max_index} "
-              f"but the consumer expects {num_col} features")
-        done = 0
-        while done < b.size:
-            take = min(b.size - done, batch_rows - filled)
-            b.slice(done, done + take).to_dense_into(
-                stage[filled:filled + take])
-            ys[filled:filled + take] = b.label[done:done + take]
-            if b.weight is not None:
-                ws[filled:filled + take] = b.weight[done:done + take]
-            else:
-                ws[filled:filled + take] = 1.0
-            filled += take
-            done += take
-            if filled == batch_rows:
-                yield stage, ys, ws
-                filled = 0
-    if filled:
-        yield stage[:filled], ys[:filled], ws[:filled]
+    from dmlc_core_tpu.stream.dataset import Dataset
+
+    return iter(Dataset.from_row_iter(row_iter)
+                .dense_slabs(num_col, batch_rows))

@@ -26,7 +26,8 @@ import os
 import struct
 from typing import Iterator, Optional
 
-from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, CHECK_LT, log_fatal
+from dmlc_core_tpu.base.logging import (CHECK, CHECK_EQ, CHECK_LT, LOG,
+                                        log_fatal)
 from dmlc_core_tpu.io.stream import Stream
 
 __all__ = [
@@ -125,12 +126,30 @@ class RecordIOReader:
 
     Accepts an open :class:`Stream` or a path/URI (opened for read via
     ``Stream.create`` and owned/closed by the reader).
+
+    Damage tolerance (beyond the reference, which asserts): a **torn
+    final record** — the partial header/payload a writer killed
+    mid-append leaves at EOF, the normal state of a live append-only
+    shard — is treated as end of stream (the partial tail is discarded
+    and ``torn_tail`` is set) instead of raising.  Mid-stream corruption
+    **resyncs on the magic marker**: the reader scans forward for the
+    next 4-byte-aligned magic with a record-start cflag (the writer's
+    magic-escaping guarantees aligned magic is a true boundary), skips
+    the garbage, warns, and counts it on ``resyncs``.  Clean input
+    decodes byte-identically to the strict reader.
     """
 
     def __init__(self, stream):
         if isinstance(stream, (str, os.PathLike)):
             stream = Stream.create(str(stream), "r")
         self._stream = stream
+        self._buf = b""
+        self._base = 0      # stream offset of _buf[0] (alignment anchor)
+        self._eof = False
+        #: count of magic-marker resyncs past corrupt byte ranges
+        self.resyncs = 0
+        #: True once a partial record was discarded at EOF
+        self.torn_tail = False
 
     def close(self) -> None:
         self._stream.close()
@@ -141,28 +160,102 @@ class RecordIOReader:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- buffered scanning ----------------------------------------------
+    def _fill(self, n: int) -> None:
+        """Grow the buffer to ≥ ``n`` bytes (or EOF)."""
+        while len(self._buf) < n and not self._eof:
+            more = self._stream.read(max(n - len(self._buf), 1 << 16))
+            if not more:
+                self._eof = True
+            else:
+                self._buf += more
+
+    def _consume(self, n: int) -> None:
+        self._buf = self._buf[n:]
+        self._base += n
+
+    def _mark_torn(self, why: str) -> None:
+        if not self.torn_tail:
+            self.torn_tail = True
+            LOG("WARNING", "RecordIO: torn record at end of stream "
+                "(offset %d): %s — treating as EOF", self._base, why)
+
+    def _resync(self) -> bool:
+        """Called with a bad magic at ``_buf[0]``: skip forward to the
+        next verifiable aligned record start.  Returns False when the
+        rest of the stream holds none (all remaining bytes consumed)."""
+        skipped = 0
+        while True:
+            idx = self._buf.find(RECORDIO_MAGIC_BYTES, 1)
+            while idx >= 0:
+                if (self._base + idx) % 4 == 0:
+                    self._fill(idx + 8)
+                    if len(self._buf) < idx + 8:
+                        break       # candidate torn at EOF — give up below
+                    lrec = _U32.unpack_from(self._buf, idx + 4)[0]
+                    if decode_flag(lrec) in (0, 1):
+                        self._consume(idx)
+                        skipped += idx
+                        self.resyncs += 1
+                        LOG("WARNING", "RecordIO: bad magic — resynced "
+                            "past %d bytes to offset %d", skipped,
+                            self._base)
+                        return True
+                idx = self._buf.find(RECORDIO_MAGIC_BYTES, idx + 1)
+            if self._eof:
+                skipped += len(self._buf)
+                self._consume(len(self._buf))
+                self.resyncs += 1
+                LOG("WARNING", "RecordIO: bad magic — %d trailing bytes "
+                    "hold no further record, treating as EOF", skipped)
+                return False
+            # keep a 7-byte tail so a header straddling reads is found
+            keep = min(len(self._buf), 7)
+            drop = len(self._buf) - keep
+            self._consume(drop)
+            skipped += drop
+            self._fill(keep + (1 << 16))
+
     def next_record(self) -> Optional[bytes]:
         """Return the next record, or None at EOF."""
         parts: list[bytes] = []
         while True:
-            head = self._stream.read(4)
-            if len(head) == 0:
-                CHECK(not parts, "RecordIO: EOF inside a multi-part record")
+            self._fill(8)
+            if len(self._buf) < 8:
+                if self._buf:
+                    self._mark_torn("truncated header")
+                    self._consume(len(self._buf))
+                elif parts:
+                    self._mark_torn("EOF inside a multi-part record")
                 return None
-            CHECK_EQ(len(head), 4, "RecordIO: truncated magic")
-            magic = _U32.unpack(head)[0]
-            CHECK_EQ(magic, RECORDIO_MAGIC, "RecordIO: bad magic")
-            lrec = _U32.unpack(self._stream.read_exact(4))[0]
+            magic = _U32.unpack_from(self._buf, 0)[0]
+            if magic != RECORDIO_MAGIC:
+                parts = []
+                if not self._resync():
+                    return None
+                continue
+            lrec = _U32.unpack_from(self._buf, 4)[0]
             cflag, clen = decode_flag(lrec), decode_length(lrec)
-            if cflag in (0, 1):
-                CHECK(not parts, "RecordIO: unexpected record start flag")
+            payload_end = 8 + clen
+            part_end = 8 + (((clen + 3) >> 2) << 2)
+            self._fill(part_end)
+            if len(self._buf) < payload_end:
+                self._mark_torn("truncated payload")
+                self._consume(len(self._buf))
+                return None
+            if cflag in (0, 1) and parts:
+                # a fresh start mid-record: the previous record lost its
+                # tail to corruption — drop it and carry on from here
+                parts = []
+                self.resyncs += 1
+                LOG("WARNING", "RecordIO: record start inside a "
+                    "multi-part record at offset %d — dropping the "
+                    "orphaned prefix", self._base)
             if cflag in (2, 3):
                 parts.append(RECORDIO_MAGIC_BYTES)  # re-insert consumed magic
             if clen:
-                parts.append(self._stream.read_exact(clen))
-            pad = (((clen + 3) >> 2) << 2) - clen
-            if pad:
-                self._stream.read_exact(pad)
+                parts.append(self._buf[8:payload_end])
+            self._consume(min(part_end, len(self._buf)))
             if cflag in (0, 3):
                 return b"".join(parts)
 

@@ -38,13 +38,13 @@ from dmlc_core_tpu.serve.client import ResilientClient  # noqa: F401
 from dmlc_core_tpu.serve.frontend import ServeFrontend  # noqa: F401
 from dmlc_core_tpu.serve.instruments import serve_metrics  # noqa: F401
 from dmlc_core_tpu.serve.registry import (ModelRegistry,  # noqa: F401
-                                          checkpoint_model,
+                                          checkpoint_model, clone_model,
                                           load_model_checkpoint)
 from dmlc_core_tpu.serve.runner import ModelRunner  # noqa: F401
 
 __all__ = [
     "ModelRunner", "DynamicBatcher", "QueueFullError",
     "BatcherClosedError", "ModelRegistry", "checkpoint_model",
-    "load_model_checkpoint", "ServeFrontend", "ResilientClient",
-    "serve_metrics",
+    "clone_model", "load_model_checkpoint", "ServeFrontend",
+    "ResilientClient", "serve_metrics",
 ]

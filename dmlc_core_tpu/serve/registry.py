@@ -38,7 +38,8 @@ from dmlc_core_tpu.parallel.checkpoint import checkpoint, load_checkpoint
 from dmlc_core_tpu.serve.instruments import serve_metrics
 from dmlc_core_tpu.serve.runner import ModelRunner
 
-__all__ = ["ModelRegistry", "checkpoint_model", "load_model_checkpoint"]
+__all__ = ["ModelRegistry", "checkpoint_model", "load_model_checkpoint",
+           "clone_model"]
 
 #: scratch-key counter for mem:// round-trips of model payloads
 _SCRATCH = itertools.count()
@@ -98,6 +99,14 @@ def _model_from_bytes(blob: bytes) -> Any:
         f"model checkpoint has unknown magic prefix {blob[:16]!r}")
 
 
+def clone_model(model: Any) -> Any:
+    """Deep-copy a model via its own ``save_model`` byte round trip —
+    the snapshot a publisher must take before handing a continuously
+    retrained model to the registry (a shared reference would mutate
+    under in-flight batches on the next refresh)."""
+    return _model_from_bytes(_model_to_bytes(model))
+
+
 def checkpoint_model(uri: str, model: Any, version: int) -> None:
     """Write ``model`` to ``uri`` as a versioned serving checkpoint
     (``version`` must be >= 1; 0 is the absent sentinel)."""
@@ -133,10 +142,17 @@ class ModelRegistry:
 
     # -- publication -----------------------------------------------------
     def publish(self, model: Any, version: Optional[int] = None,
-                source: Optional[str] = None) -> int:
+                source: Optional[str] = None, activate: bool = True) -> int:
         """Register ``model`` (wrapped in a :class:`ModelRunner`) and
         atomically make it current.  ``version=None`` auto-increments;
-        an explicit version must exceed every published version."""
+        an explicit version must exceed every published version.
+
+        ``activate=False`` **stages** the version instead: it is
+        retained (and counts toward monotonicity) but the current
+        pointer does not move — traffic keeps flowing to the old
+        version until an explicit :meth:`activate`.  This is the
+        publish-then-gate path the streaming publisher uses
+        (doc/streaming.md)."""
         runner = ModelRunner(model, name=self.name, **self._runner_opts)
         with self._lock:
             last = max(self._versions) if self._versions else 0
@@ -146,10 +162,11 @@ class ModelRegistry:
                   f"registry {self.name!r}: version {version} is not "
                   f"monotonic (latest published is {last})")
             self._versions[version] = runner
-            self._current = (version, runner)   # THE atomic swap
-        LOG("INFO", "serve.registry %s: published v%d (%s)%s",
-            self.name, version, type(model).__name__,
-            f" from {source}" if source else "")
+            if activate:
+                self._current = (version, runner)   # THE atomic swap
+        LOG("INFO", "serve.registry %s: %s v%d (%s)%s",
+            self.name, "published" if activate else "staged", version,
+            type(model).__name__, f" from {source}" if source else "")
         if _metrics.enabled():
             serve_metrics()["model_info"].set(
                 1, version=str(version),

@@ -1,0 +1,43 @@
+"""Streaming / online learning: the continuous train→serve loop.
+
+The paper's data plane (RecordIO shards + ``input_split`` + threaded
+prefetch) existed here only as a batch path; this package closes ROADMAP
+item 5 — a live event stream becomes continuously-updated low-latency
+predictions — by composing shelf parts the repo already had:
+
+* :mod:`dataset` — the enabler refactor: ONE streaming
+  :class:`Dataset` abstraction over ``io/threaded_iter`` +
+  ``data/parsers`` + ``data/device_feed``, shared by batch trainers
+  (``data/iter.iter_dense_slabs`` is now an adapter over it) and the
+  online path; plus the dense event codec.
+* :mod:`tail` — :class:`RecordIOTailer`: follow a growing append-only
+  RecordIO shard set with torn-tail tolerance, magic-marker resync past
+  corruption, jittered idle backoff, and a crash-safe cursor persisted
+  through ``parallel.checkpoint`` atomic writes.
+* :mod:`trainer` — :class:`OnlineTrainer`: warm-start-boost the
+  existing HistGBT ensemble on fresh chunks (sliding window /
+  exponentially-decayed sample weights; steady-state shapes stay fixed
+  so refreshes never recompile).
+* :mod:`publisher` — :class:`ModelPublisher`: snapshot each refresh,
+  stage it into ``serve.ModelRegistry``, eval-gate on a holdout window,
+  atomically activate — or roll back on regression.
+
+One command takes a synthetic live stream to served predictions
+(``examples/stream_gbt.py``); ``bench.py --stream`` measures staleness
+(event appended → servable prediction).  See doc/streaming.md.
+"""
+
+from dmlc_core_tpu.stream.dataset import (Dataset,  # noqa: F401
+                                          decode_dense_events,
+                                          encode_dense_event,
+                                          encode_dense_events)
+from dmlc_core_tpu.stream.publisher import ModelPublisher  # noqa: F401
+from dmlc_core_tpu.stream.tail import (RecordIOTailer,  # noqa: F401
+                                       TailCursor)
+from dmlc_core_tpu.stream.trainer import OnlineTrainer  # noqa: F401
+
+__all__ = [
+    "Dataset", "RecordIOTailer", "TailCursor", "OnlineTrainer",
+    "ModelPublisher", "encode_dense_event", "encode_dense_events",
+    "decode_dense_events",
+]

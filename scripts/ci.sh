@@ -58,6 +58,13 @@ env JAX_PLATFORMS=cpu DMLC_COMPILE_CACHE_DIR="$CC_DIR" \
 env JAX_PLATFORMS=cpu DMLC_COMPILE_CACHE_DIR="$CC_DIR" \
     DMLC_COMPILE_CACHE_EXPECT=hit python scripts/check_compile_cache.py
 
+echo "== stream smoke (append -> tail -> boost -> publish -> serve) =="
+# the continuous train->serve loop end to end (doc/streaming.md): a
+# bounded synthetic event stream must yield >= 2 published model
+# versions and a final registry that answers HTTP /predict — the
+# examples/stream_gbt.py --smoke assertions
+env JAX_PLATFORMS=cpu DMLC_TPU_FORCE_CPU=2 python examples/stream_gbt.py --smoke
+
 echo "== resilience smoke (kill-and-recover + lossy wire) =="
 # deterministic fault-injection drills: SIGKILL a checkpoint writer
 # mid-write and prove the previous version survives bit-identically,

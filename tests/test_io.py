@@ -355,10 +355,61 @@ class TestRecordIO:
             w.write_record(r)
         assert list(RecordIOChunkReader(bytes(s.data))) == records
 
-    def test_bad_magic_fatal(self):
+    def test_garbage_only_stream_is_empty(self):
+        # pure garbage: no records, counted as a resync, no raise (the
+        # tolerant-reader contract — doc/streaming.md)
         s = MemoryStringStream(bytearray(b"\x00" * 8))
-        with pytest.raises(Error, match="magic"):
-            RecordIOReader(s).next_record()
+        r = RecordIOReader(s)
+        assert r.next_record() is None
+        assert r.resyncs == 1
+
+    def _encoded(self, records):
+        s = MemoryStringStream()
+        w = RecordIOWriter(s)
+        for rec in records:
+            w.write_record(rec)
+        return bytes(s.data)
+
+    def test_torn_final_record_truncated_mid_payload(self):
+        # a writer SIGKILLed mid-append leaves a partial tail: the
+        # reader must deliver every complete record and treat the torn
+        # one as EOF instead of raising
+        records = [b"alpha", b"beta" * 50, b"gamma" * 9]
+        blob = self._encoded(records)
+        for cut in (1, 3, 5, 9, 15):   # header, lrec and payload tears
+            last_start = len(self._encoded(records[:-1]))
+            torn = blob[:last_start + cut]
+            r = RecordIOReader(MemoryStringStream(bytearray(torn)))
+            assert list(r) == records[:-1]
+            assert r.torn_tail
+
+    def test_torn_tail_multipart_record(self):
+        # escaped-magic records span multiple parts; tearing between
+        # parts must drop the whole partial record
+        records = [b"ok1", RECORDIO_MAGIC_BYTES * 4 + b"tail"]
+        blob = self._encoded(records)
+        first = len(self._encoded(records[:1]))
+        torn = blob[:first + 14]       # inside the multi-part record
+        r = RecordIOReader(MemoryStringStream(bytearray(torn)))
+        assert list(r) == [b"ok1"]
+        assert r.torn_tail
+
+    def test_resync_past_corrupt_bytes(self):
+        # corruption between two valid records: resync on the aligned
+        # magic marker and keep reading (instead of raising)
+        good = self._encoded([b"first-record"])
+        rest = self._encoded([b"second", b"third!!!"])
+        blob = good + b"\xde\xad\xbe\xef" * 3 + rest
+        r = RecordIOReader(MemoryStringStream(bytearray(blob)))
+        assert list(r) == [b"first-record", b"second", b"third!!!"]
+        assert r.resyncs == 1
+
+    def test_clean_stream_unaffected_by_tolerance(self):
+        records = [os.urandom(n) for n in (0, 1, 7, 128)]
+        r = RecordIOReader(MemoryStringStream(bytearray(
+            self._encoded(records))))
+        assert list(r) == records
+        assert r.resyncs == 0 and not r.torn_tail
 
 
 def _write_lines(path, lines):

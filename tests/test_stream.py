@@ -1,0 +1,546 @@
+"""stream/ subsystem: Dataset, tailer, online trainer, publisher.
+
+Contracts pinned here (doc/streaming.md):
+* Dataset is the one staging path — ``data/iter.iter_dense_slabs`` is an
+  adapter over it, slabs/bounds/weights behave exactly as before.
+* The tailer delivers complete records exactly once in-process, holds
+  back torn tails until the append completes, resyncs past corruption,
+  and resumes from its committed cursor after a SIGKILL — including a
+  SIGKILL *during* the cursor commit itself (checkpoint:kill).
+* Warm-start parity: OnlineTrainer(window_chunks=1, decay=1.0) over
+  chunks A then B is bit-identical to ``fit(A); fit(B)``.
+* The publisher stages (publish without activate), eval-gates, and
+  rolls back a poisoned refresh with traffic still on the old version.
+* Slow soak: append → tail → boost → hot-swap → HTTP predict with zero
+  dropped requests, and the surviving checkpointed version reloads to
+  bit-identical predictions.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.io.recordio import encode_records
+from dmlc_core_tpu.models import HistGBT
+from dmlc_core_tpu.serve import ModelRegistry
+from dmlc_core_tpu.stream import (Dataset, ModelPublisher, OnlineTrainer,
+                                  RecordIOTailer, TailCursor,
+                                  decode_dense_events, encode_dense_event,
+                                  encode_dense_events)
+
+N_F = 6
+
+
+def _make_xy(n, seed, flip=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, N_F)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
+    if flip:
+        y = 1.0 - y
+    return X, y
+
+
+def _write_events(path, X, y, mode="ab"):
+    with open(path, mode) as f:
+        f.write(encode_records(encode_dense_events(X, y)))
+
+
+def _small_model(**kw):
+    args = dict(n_trees=3, max_depth=3, n_bins=16, learning_rate=0.3)
+    args.update(kw)
+    return HistGBT(**args)
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+
+class TestDataset:
+    def _libsvm_file(self, tmp_path, n=40):
+        rng = np.random.default_rng(3)
+        lines = []
+        dense = np.zeros((n, 4), np.float32)
+        labels = np.zeros(n, np.float32)
+        for i in range(n):
+            labels[i] = float(i % 2)
+            feats = []
+            for j in range(4):
+                v = round(float(rng.normal()), 3)
+                dense[i, j] = v
+                feats.append(f"{j}:{v}")
+            lines.append(f"{labels[i]} " + " ".join(feats))
+        path = os.path.join(tmp_path, "data.svm")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path, dense, labels
+
+    def test_from_uri_dense_slabs(self, tmp_path):
+        path, dense, labels = self._libsvm_file(tmp_path)
+        ds = Dataset.from_uri(path, format="libsvm").dense_slabs(4, 16)
+        got_x, got_y = [], []
+        for X, y, w in ds:
+            got_x.append(X.copy())       # slabs are views — copy
+            got_y.append(y.copy())
+            assert np.all(w == 1.0)
+            assert len(X) <= 16
+        np.testing.assert_array_equal(np.concatenate(got_x), dense)
+        np.testing.assert_array_equal(np.concatenate(got_y), labels)
+
+    def test_rewind_and_map(self, tmp_path):
+        path, dense, _ = self._libsvm_file(tmp_path)
+        ds = Dataset.from_uri(path, format="libsvm").map(
+            lambda b: b.size)
+        first = list(ds)
+        second = list(ds)                # re-iterate → parser rewinds
+        assert first == second
+        assert sum(first) == len(dense)
+
+    def test_prefetch_preserves_order(self):
+        items = list(range(57))
+        ds = Dataset.from_iterable(lambda: iter(items)).prefetch(4)
+        assert list(ds) == items
+        assert list(ds) == items         # per-iteration ThreadedIter
+
+    def test_iter_dense_slabs_adapter(self, tmp_path):
+        # the batch-path entry point is now an adapter over Dataset —
+        # same slabs, same bounded staging
+        from dmlc_core_tpu.data.iter import RowBlockIter, iter_dense_slabs
+
+        path, dense, labels = self._libsvm_file(tmp_path)
+        it = RowBlockIter.create(path + "?format=libsvm")
+        outs = [(X.copy(), y.copy())
+                for X, y, _ in iter_dense_slabs(it, 4, 7)]
+        assert all(len(x) <= 7 for x, _ in outs)
+        np.testing.assert_array_equal(
+            np.concatenate([x for x, _ in outs]), dense)
+        np.testing.assert_array_equal(
+            np.concatenate([y for _, y in outs]), labels)
+
+    def test_event_codec_round_trip(self):
+        X, y = _make_xy(33, seed=5)
+        recs = encode_dense_events(X, y)
+        assert recs[0] == encode_dense_event(X[0], y[0])
+        X2, y2 = decode_dense_events(recs, N_F)
+        np.testing.assert_array_equal(X, X2)
+        np.testing.assert_array_equal(y, y2)
+
+
+# ---------------------------------------------------------------------------
+# Tailer
+# ---------------------------------------------------------------------------
+
+class TestTailer:
+    def test_tail_growing_shard_set(self, tmp_path):
+        d = os.path.join(tmp_path, "events")
+        os.makedirs(d)
+        X1, y1 = _make_xy(64, 1)
+        _write_events(os.path.join(d, "part-000.rec"), X1, y1)
+        t = RecordIOTailer(d, name="grow")
+        assert len(t.poll()) == 64
+        assert t.poll() == []            # nothing new
+        # append to the existing shard AND add a new one
+        X2, y2 = _make_xy(32, 2)
+        _write_events(os.path.join(d, "part-000.rec"), X2, y2)
+        X3, y3 = _make_xy(16, 3)
+        _write_events(os.path.join(d, "part-001.rec"), X3, y3)
+        got = t.poll()
+        assert len(got) == 48
+        Xg, _ = decode_dense_events(got, N_F)
+        np.testing.assert_array_equal(Xg, np.concatenate([X2, X3]))
+        t.close()
+
+    def test_torn_tail_held_back_until_complete(self, tmp_path):
+        path = os.path.join(tmp_path, "s.rec")
+        X, y = _make_xy(8, 4)
+        blob = encode_records(encode_dense_events(X, y))
+        cut = len(blob) - 13             # mid-record tear
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        t = RecordIOTailer(path, name="torn")
+        assert len(t.poll()) == 7        # the torn 8th is held back
+        assert t.poll() == []            # stable: no re-delivery, no error
+        with open(path, "ab") as f:
+            f.write(blob[cut:])          # writer finishes the append
+        got = t.poll()
+        assert len(got) == 1
+        Xg, _ = decode_dense_events(got, N_F)
+        np.testing.assert_array_equal(Xg, X[7:8])
+
+    def test_resync_past_corruption(self, tmp_path):
+        path = os.path.join(tmp_path, "s.rec")
+        X, y = _make_xy(4, 5)
+        good = encode_records(encode_dense_events(X, y))
+        with open(path, "wb") as f:
+            f.write(good + b"\x00" * 16 + good)
+        t = RecordIOTailer(path, name="corrupt")
+        got = t.poll()
+        assert len(got) == 8
+        assert t.resyncs >= 1
+
+    def test_cursor_commit_and_resume(self, tmp_path):
+        path = os.path.join(tmp_path, "s.rec")
+        cursor = os.path.join(tmp_path, "cursor.ckpt")
+        X, y = _make_xy(100, 6)
+        _write_events(path, X, y, mode="wb")
+        t = RecordIOTailer(path, cursor_uri=cursor, name="cur")
+        assert len(t.poll()) == 100
+        v = t.commit()
+        assert v == 1
+        # a new process (fresh tailer) resumes after the committed 100
+        t2 = RecordIOTailer(path, cursor_uri=cursor, name="cur2")
+        assert t2.records_seen == 100
+        assert t2.poll() == []
+        X2, y2 = _make_xy(10, 7)
+        _write_events(path, X2, y2)
+        got = t2.poll()
+        Xg, _ = decode_dense_events(got, N_F)
+        np.testing.assert_array_equal(Xg, X2)
+        assert t2.commit() == 2          # version stays monotone
+
+    def test_wait_records_timeout_and_stop(self, tmp_path):
+        path = os.path.join(tmp_path, "s.rec")
+        X, y = _make_xy(5, 8)
+        _write_events(path, X, y, mode="wb")
+        t = RecordIOTailer(path, poll_s=0.01, name="wait")
+        t0 = time.monotonic()
+        got = t.wait_records(10, timeout=0.3)
+        assert len(got) == 5             # returns what arrived
+        assert time.monotonic() - t0 >= 0.28
+        stop = threading.Event()
+        stop.set()
+        assert t.wait_records(10, timeout=5.0, stop=stop.is_set) == []
+
+    def test_cursor_round_trip(self):
+        c = TailCursor({"/a/b.rec": 1234}, records=77)
+        c2 = TailCursor.from_leaf(c.to_leaf())
+        assert c2.offsets == {"/a/b.rec": 1234}
+        assert c2.records == 77
+
+
+_KILL_CHILD = r"""
+import os, struct, sys
+os.environ.setdefault("DMLC_TPU_FORCE_CPU", "1")
+sys.path.insert(0, sys.argv[4])
+from dmlc_core_tpu.stream import RecordIOTailer
+
+shard, cursor, log = sys.argv[1], sys.argv[2], sys.argv[3]
+t = RecordIOTailer(shard, cursor_uri=cursor, name="victim")
+out = open(log, "a")
+while True:
+    recs = t.wait_records(100, timeout=5.0)
+    if not recs:
+        break
+    seqs = [struct.unpack("<q", r)[0] for r in recs]
+    out.write("delivered %d %d\n" % (seqs[0], seqs[-1]))
+    out.flush()
+    t.commit()                       # the 3rd commit SIGKILLs mid-write
+    out.write("committed %d\n" % t.records_seen)
+    out.flush()
+print("CLEAN EXIT")                   # must never be reached
+"""
+
+
+class TestSigkillResume:
+    def test_resume_after_sigkill_during_commit(self, tmp_path):
+        """SIGKILL fired INSIDE the cursor checkpoint write
+        (base/faultinject checkpoint:kill): the atomic write leaves the
+        previous cursor intact, and a restarted tailer re-delivers
+        exactly the records after the last durable commit — no loss, no
+        skip."""
+        shard = os.path.join(tmp_path, "events.rec")
+        cursor = os.path.join(tmp_path, "cursor.ckpt")
+        log = os.path.join(tmp_path, "progress.log")
+        with open(shard, "wb") as f:
+            f.write(encode_records(
+                [struct.pack("<q", i) for i in range(500)]))
+        child = os.path.join(tmp_path, "child.py")
+        with open(child, "w") as f:
+            f.write(_KILL_CHILD)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu", DMLC_TPU_FORCE_CPU="1",
+                   DMLC_FAULT_INJECT="checkpoint:kill:after=2")
+        proc = subprocess.run(
+            [sys.executable, child, shard, cursor, log, repo],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert proc.returncode == -9, \
+            f"expected SIGKILL, got {proc.returncode}: {proc.stderr[-500:]}"
+        assert "CLEAN EXIT" not in proc.stdout
+        lines = open(log).read().splitlines()
+        committed = [int(l.split()[1]) for l in lines
+                     if l.startswith("committed")]
+        assert committed == [100, 200], lines  # 3rd commit died mid-write
+        # restart: resumes from the durable cursor (200), not from the
+        # records the victim delivered-but-never-committed
+        t = RecordIOTailer(shard, cursor_uri=cursor, name="resumed")
+        assert t.records_seen == 200
+        recs = t.poll()
+        seqs = [struct.unpack("<q", r)[0] for r in recs]
+        assert seqs == list(range(200, 500))
+        assert t.commit() == 3           # version continues past the crash
+
+
+# ---------------------------------------------------------------------------
+# OnlineTrainer
+# ---------------------------------------------------------------------------
+
+class TestWarmStartParity:
+    def test_online_equals_sequential_continued_fits(self, tmp_path):
+        """The documented continuation contract: OnlineTrainer with
+        window_chunks=1, decay=1.0 over chunks A then B produces
+        bit-identical predictions to fit(A); fit(B) on the same
+        parameterization (online learning IS repeated continued fits)."""
+        XA, yA = _make_xy(256, 11)
+        XB, yB = _make_xy(256, 12)
+        Xq, _ = _make_xy(128, 13)
+
+        manual = _small_model()
+        manual.fit(XA, yA)
+        manual.fit(XB, yB)               # warm start: cuts kept, margins replayed
+
+        shard = os.path.join(tmp_path, "events.rec")
+        _write_events(shard, XA, yA, mode="wb")
+        _write_events(shard, XB, yB)
+        online = _small_model()
+        trainer = OnlineTrainer(online, RecordIOTailer(shard, name="par"),
+                                n_features=N_F, chunk_rows=256,
+                                window_chunks=1, decay=1.0,
+                                commit_cursor=False)
+        outs = trainer.run(max_refreshes=4, timeout=0.2)
+        assert [o["rows"] for o in outs] == [256, 256]
+        assert len(online.trees) == len(manual.trees) == 6
+        np.testing.assert_array_equal(manual.predict(Xq),
+                                      online.predict(Xq))
+
+    def test_decay_weights_window(self, tmp_path):
+        """decay < 1 trains each refresh on the concatenated window with
+        decay^age sample weights — equivalent to a manual weighted
+        continued fit."""
+        XA, yA = _make_xy(128, 21)
+        XB, yB = _make_xy(128, 22)
+        Xq, _ = _make_xy(64, 23)
+
+        manual = _small_model()
+        manual.fit(XA, yA, weight=None)  # refresh 1 (single chunk, decay
+        # weights all 1 would differ; trainer passes the decayed vector)
+
+        shard = os.path.join(tmp_path, "events.rec")
+        _write_events(shard, XA, yA, mode="wb")
+        _write_events(shard, XB, yB)
+        online = _small_model()
+        trainer = OnlineTrainer(online, RecordIOTailer(shard, name="dec"),
+                                n_features=N_F, chunk_rows=128,
+                                window_chunks=2, decay=0.5,
+                                commit_cursor=False)
+        outs = trainer.run(max_refreshes=4, timeout=0.2)
+        assert [o["window_rows"] for o in outs] == [128, 256]
+
+        manual2 = _small_model()
+        w1 = np.full(128, 1.0, np.float32)        # single-chunk window
+        manual2.fit(XA, yA, weight=w1)
+        w2 = np.concatenate([np.full(128, 0.5, np.float32),
+                             np.full(128, 1.0, np.float32)])
+        manual2.fit(np.concatenate([XA, XB]), np.concatenate([yA, yB]),
+                    weight=w2)
+        np.testing.assert_array_equal(manual2.predict(Xq),
+                                      online.predict(Xq))
+
+
+# ---------------------------------------------------------------------------
+# Publisher
+# ---------------------------------------------------------------------------
+
+class TestPublisher:
+    def test_staged_publish_leaves_current_untouched(self):
+        X, y = _make_xy(256, 31)
+        m1 = _small_model().fit(X, y)
+        reg = ModelRegistry(max_batch=64, min_bucket=8)
+        v1 = reg.publish(m1, source="base")          # active
+        m2 = _small_model(n_trees=5).fit(X, y)
+        v2 = reg.publish(m2, source="staged", activate=False)
+        assert reg.current_version() == v1           # pointer never moved
+        assert reg.versions() == [v1, v2]            # ...but v2 retained
+        reg.activate(v2)
+        assert reg.current_version() == v2
+
+    def test_snapshot_isolated_from_live_model(self):
+        X, y = _make_xy(256, 32)
+        model = _small_model().fit(X, y)
+        reg = ModelRegistry(max_batch=64, min_bucket=8)
+        pub = ModelPublisher(reg, name="iso")        # no holdout: always on
+        pub.publish(model)
+        _, runner = reg.current()
+        before = np.asarray(runner.predict(X[:16]))
+        model.fit(X, y)                              # mutate the live model
+        after = np.asarray(runner.predict(X[:16]))
+        np.testing.assert_array_equal(before, after)  # served copy frozen
+
+    def test_rollback_on_poisoned_refresh(self):
+        """A refresh trained on poisoned data regresses on the holdout;
+        the publisher stages it but never activates — traffic stays on
+        the old version, bit-identically."""
+        X, y = _make_xy(512, 33)
+        Xh, yh = _make_xy(512, 34)
+        model = _small_model()
+        model.fit(X, y)
+        reg = ModelRegistry(max_batch=64, min_bucket=8)
+        pub = ModelPublisher(reg, holdout=(Xh, yh), gate=0.1,
+                             name="gate")
+        r1 = pub.publish(model)
+        assert r1["activated"] and reg.current_version() == r1["version"]
+        _, runner = reg.current()
+        good_preds = np.asarray(runner.predict(Xh[:32]))
+
+        Xp, yp = _make_xy(512, 35, flip=True)        # poisoned labels
+        model.fit(Xp, yp)
+        model.fit(Xp, yp)
+        r2 = pub.publish(model)
+        assert not r2["activated"], (r1, r2)
+        assert r2["score"] > r1["score"]
+        assert reg.current_version() == r1["version"]
+        assert r2["version"] in reg.versions()       # kept for postmortem
+        _, runner = reg.current()
+        np.testing.assert_array_equal(
+            np.asarray(runner.predict(Xh[:32])), good_preds)
+        assert pub.rollbacks == 1 and pub.activations == 1
+
+    def test_checkpointed_version_survives(self, tmp_path):
+        X, y = _make_xy(256, 36)
+        model = _small_model().fit(X, y)
+        reg = ModelRegistry(max_batch=64, min_bucket=8)
+        ckpt = os.path.join(tmp_path, "model.ckpt")
+        pub = ModelPublisher(reg, checkpoint_uri=ckpt, name="ck")
+        r = pub.publish(model)
+        _, runner = reg.current()
+        want = np.asarray(runner.predict(X[:16]))
+        # a fresh process restores the surviving version bit-identically
+        reg2 = ModelRegistry(max_batch=64, min_bucket=8)
+        assert reg2.load(ckpt) == r["version"]
+        _, runner2 = reg2.current()
+        np.testing.assert_array_equal(
+            np.asarray(runner2.predict(X[:16])), want)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end soak (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestStreamSoak:
+    def test_append_tail_boost_swap_serve_zero_drops(self, tmp_path):
+        """Live loop under HTTP traffic: a writer appends chunks while
+        the trainer refreshes and hot-swaps versions; concurrent HTTP
+        clients must see zero dropped requests, and the checkpointed
+        surviving version must reload bit-identically."""
+        from dmlc_core_tpu.serve import ServeFrontend
+
+        d = os.path.join(tmp_path, "events")
+        os.makedirs(d)
+        chunk = 384
+        n_chunks = 3
+        done_writing = threading.Event()
+
+        def writer():
+            for i in range(n_chunks):
+                X, y = _make_xy(chunk, 41 + i)
+                _write_events(os.path.join(d, f"p-{i:02d}.rec"), X, y)
+                time.sleep(0.3)
+            done_writing.set()
+
+        Xh, yh = _make_xy(1024, 40)
+        reg = ModelRegistry(max_batch=128, min_bucket=8)
+        ckpt = os.path.join(tmp_path, "model.ckpt")
+        pub = ModelPublisher(reg, holdout=(Xh, yh),
+                             checkpoint_uri=ckpt, name="soak")
+        model = _small_model()
+        tailer = RecordIOTailer(
+            d, cursor_uri=os.path.join(tmp_path, "cursor.ckpt"),
+            name="soak")
+        trainer = OnlineTrainer(model, tailer, n_features=N_F,
+                                chunk_rows=chunk, window_chunks=2,
+                                decay=1.0, publisher=pub, name="soak")
+
+        results = {"ok": 0, "errors": []}
+        stop_clients = threading.Event()
+
+        def client(tid):
+            body = json.dumps({"rows": Xh[:4].tolist()}).encode()
+            while not stop_clients.is_set():
+                try:
+                    req = urllib.request.Request(
+                        url + "/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = json.loads(
+                        urllib.request.urlopen(req, timeout=30).read())
+                    assert len(resp["predictions"]) == 4
+                    results["ok"] += 1
+                except Exception as e:  # noqa: BLE001
+                    results["errors"].append(f"{tid}: {e}")
+                time.sleep(0.02)
+
+        threading.Thread(target=writer, daemon=True).start()
+        with ServeFrontend(reg, max_batch=128, max_delay=0.002) as fe:
+            url = fe.url
+            # first refresh publishes v1, then clients start
+            first = trainer.refresh(timeout=60.0)
+            assert first is not None and first["activated"]
+            clients = [threading.Thread(target=client, args=(i,),
+                                        daemon=True) for i in range(2)]
+            for c in clients:
+                c.start()
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                r = trainer.refresh(timeout=5.0)
+                if r is None and done_writing.is_set() \
+                        and tailer.records_seen >= chunk * n_chunks:
+                    break
+            stop_clients.set()
+            for c in clients:
+                c.join(timeout=10)
+            # zero dropped requests across every hot-swap
+            assert results["errors"] == []
+            assert results["ok"] > 0
+            assert len(reg.versions()) >= 2
+            cur_v, runner = reg.current()
+            want = np.asarray(runner.predict(Xh[:16]))
+        # the surviving version reloads bit-identically (crash-restart
+        # consistency: the publisher checkpointed every activation)
+        reg2 = ModelRegistry(max_batch=128, min_bucket=8)
+        assert reg2.load(ckpt) == cur_v
+        _, runner2 = reg2.current()
+        np.testing.assert_array_equal(
+            np.asarray(runner2.predict(Xh[:16])), want)
+        tailer.close()
+
+
+@pytest.mark.slow
+class TestStreamBenchMode:
+    def test_bench_stream_emits_staleness_json(self, tmp_path):
+        """bench.py --stream's contract: final JSON carries
+        staleness_seconds {p50,p95,p99} and refreshes_published."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="2",
+                   STREAM_SECONDS="4", STREAM_EVENTS_PER_SEC="600",
+                   STREAM_CHUNK_ROWS="256", STREAM_TREES="2",
+                   BENCH_FEATURES="6",
+                   BENCH_METRICS_OUT=os.path.join(tmp_path, "m.json"))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"), "--stream"],
+            env=env, capture_output=True, text=True, timeout=300,
+            cwd=repo)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        final = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert final["metric"] == "stream_staleness_seconds"
+        assert set(final["staleness_seconds"]) == {"p50", "p95", "p99"}
+        assert final["staleness_seconds"]["p95"] is not None
+        assert final["refreshes_published"] >= 1
+        assert final["events_served"] > 0
+        assert os.path.exists(os.path.join(tmp_path, "m.json"))
