@@ -144,6 +144,15 @@ declare("DMLC_INGEST_CHUNK_ROWS", 2_000_000,
 declare("DMLC_COLDSTART_OVERLAP", "1",
         "0 restores the serial bin-then-compile cold start (no "
         "ingest/compile overlap).", "gbt")
+declare("DMLC_SHARDED_INGEST", "1",
+        "0 restores the single global device_put staging path; 1 "
+        "streams each chip's row slice onto that chip only "
+        "(bit-identical either way).", "gbt")
+declare("DMLC_HIST_BLOCKS", 0,
+        "N>0 enables the mesh-shape-invariant deterministic histogram "
+        "reduction with N fixed row blocks (rounded up to a power of "
+        "two >= the data-axis size): trees become bit-identical across "
+        "mesh shapes; 0 keeps the faster plain psum.", "gbt")
 
 # -- compile cache ----------------------------------------------------------
 declare("DMLC_COMPILE_CACHE", "1",

@@ -31,7 +31,41 @@ from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.io.threaded_iter import ThreadedIter
 
-__all__ = ["DeviceFeed", "FeedStats"]
+__all__ = ["DeviceFeed", "FeedStats", "assemble_row_sharded"]
+
+
+def assemble_row_sharded(per_device, mesh: Mesh, dim: int = 0,
+                         axis: str = "data") -> jax.Array:
+    """Stitch per-device shards into ONE global array sharded on ``dim``.
+
+    ``per_device`` holds one equal-shape array per device of a 1-axis
+    mesh, in axis order; host arrays are device_put (committed) onto
+    their device, already-committed device arrays pass through.  The
+    result is a global ``jax.Array`` with
+    ``NamedSharding(mesh, P(..., axis, ...))`` — byte-identical to a
+    whole-matrix ``device_put`` of the concatenation, without the
+    concatenated host (or single-device) copy ever existing.  This is
+    the assembly step of sharded ingest (boundary #3 of the data
+    pipeline, per-chip edition): each chip's slice arrives on that chip
+    and nowhere else.
+    """
+    devs = list(np.asarray(mesh.devices).flat)
+    CHECK(len(per_device) == len(devs),
+          f"assemble_row_sharded: {len(per_device)} shards for "
+          f"{len(devs)} devices")
+    shards = []
+    for arr, dev in zip(per_device, devs):
+        if isinstance(arr, jax.Array) and arr.committed:
+            shards.append(arr)
+        else:
+            shards.append(jax.device_put(arr, dev))
+    ndim = shards[0].ndim
+    CHECK(0 <= dim < ndim, f"assemble_row_sharded: dim {dim} out of range")
+    shape = list(shards[0].shape)
+    shape[dim] *= len(devs)
+    spec = P(*[axis if i == dim else None for i in range(ndim)])
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), NamedSharding(mesh, spec), shards)
 
 
 class FeedStats:

@@ -27,7 +27,7 @@ from dmlc_core_tpu.io.threaded_iter import ThreadedIter
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
 __all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter",
-           "iter_dense_slabs"]
+           "iter_dense_slabs", "slab_shard_slices"]
 
 # target bytes per cache page (reference uses a row-count heuristic; byte
 # budget maps better to fixed host-staging buffers)
@@ -238,6 +238,31 @@ class DiskRowIter(RowBlockIter):
 
     def close(self) -> None:
         self._stop_reader()
+
+
+def slab_shard_slices(lo: int, length: int, shard_rows: int):
+    """Map an ingest slab occupying global rows ``[lo, lo+length)`` onto
+    the equal-block device layout (device ``k`` owns rows
+    ``[k·shard_rows, (k+1)·shard_rows)``): returns
+    ``[(shard, src_lo, src_hi, dst_lo), ...]`` pieces, in order, whose
+    source slices tile the slab exactly.
+
+    This is the tail math of sharded ingest: a streamed chunk rarely
+    aligns with shard boundaries — the last chunk of a
+    ``nrows % (chips · chunk)`` tail may start mid-shard and end
+    mid-shard — so every piece must land at its exact per-shard offset
+    ``dst_lo`` with no row dropped or written twice (property-pinned in
+    tests/test_multichip.py).
+    """
+    out = []
+    pos = lo
+    end = lo + length
+    while pos < end:
+        k = pos // shard_rows
+        take = min(end, (k + 1) * shard_rows) - pos
+        out.append((k, pos - lo, pos - lo + take, pos - k * shard_rows))
+        pos += take
+    return out
 
 
 def iter_dense_slabs(row_iter, num_col: int, batch_rows: int):

@@ -48,12 +48,15 @@ from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG, log_fatal
 from dmlc_core_tpu.base.parameter import Parameter, field, get_env
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
+from dmlc_core_tpu.data.device_feed import assemble_row_sharded
+from dmlc_core_tpu.data.iter import slab_shard_slices
 from dmlc_core_tpu.ops.histogram import (build_histogram,
                                          fused_descend_histogram,
+                                         hist_psum_bytes_per_round,
                                          select_feature_bins)
 from dmlc_core_tpu.ops.quantile import (apply_bins, apply_bins_missing,
                                         compute_cuts)
-from dmlc_core_tpu.parallel.mesh import local_mesh
+from dmlc_core_tpu.parallel.mesh import device_count, local_mesh
 from dmlc_core_tpu.models.gbt_objectives import (  # noqa: F401  (re-exports:
     # scripts/tests import these via models.histgbt — keep the names)
     EVAL_METRICS, OBJECTIVES, _METRICS_BY_OBJECTIVE, _Logistic,
@@ -112,6 +115,45 @@ def _ingest_chunk_rows(ndev: int) -> int:
     return max(1, rows // ndev) * ndev
 
 
+def _hist_blocks(data_size: int) -> int:
+    """Resolved deterministic-histogram block count ``C`` (0 = off).
+
+    ``DMLC_HIST_BLOCKS=N`` (N>0) turns on the mesh-shape-INVARIANT
+    histogram reduction: rows are cut into ``C`` fixed global blocks
+    (``N`` rounded up to a power of two ≥ the data-axis size), each
+    block's histogram is built separately, and all reductions — the
+    per-shard fold AND the cross-chip combine — run the same fixed
+    pairwise tree.  Because a shard's blocks form an aligned subtree of
+    that tree, a 1-chip fit and an N-chip fit of the SAME global rows
+    produce bit-identical sums, hence bit-identical trees (the
+    single-chip-oracle contract of doc/performance.md).  The plain
+    ``psum`` path (default) is faster but its accumulation order — and
+    therefore last-ulp gains, and occasionally a near-tie split — varies
+    with the mesh shape.
+    """
+    v = get_env("DMLC_HIST_BLOCKS", 0, int)
+    if v <= 0:
+        return 0
+    CHECK(data_size & (data_size - 1) == 0,
+          f"DMLC_HIST_BLOCKS needs a power-of-two data-axis size, "
+          f"got {data_size}")
+    c = 1
+    while c < max(v, data_size):
+        c <<= 1
+    return c
+
+
+def _tree_fold(parts):
+    """Fixed-order pairwise fold of a power-of-two list of arrays — the
+    one reduction tree every mesh shape shares (see :func:`_hist_blocks`).
+    ``((p0+p1)+(p2+p3))+...``: any aligned contiguous power-of-two
+    sub-range folds to the exact value the full fold uses as its
+    subtree, which is what makes per-shard partials composable."""
+    while len(parts) > 1:
+        parts = [parts[i] + parts[i + 1] for i in range(0, len(parts), 2)]
+    return parts[0]
+
+
 @lru_cache(maxsize=32)
 def _bin_chunk_fn(mesh: Mesh, missing: bool, miss_bin: int):
     """Jitted per-(mesh, mode) chunk binning: digitize a row-sharded
@@ -123,6 +165,29 @@ def _bin_chunk_fn(mesh: Mesh, missing: bool, miss_bin: int):
              else apply_bins(xc, cuts))
         return b.T
     return jax.jit(f, out_shardings=NamedSharding(mesh, P(None, "data")))
+
+
+@lru_cache(maxsize=8)
+def _bin_piece_fn(missing: bool, miss_bin: int):
+    """Jitted single-device piece binning for the SHARDED ingest: the
+    committed f32 piece pins the computation (and its uint8 output) to
+    that piece's device, so each chip bins exactly its own row slice —
+    no global resharding, no cross-chip traffic.  One program per
+    (mode, piece shape); cuts ride as a traced arg."""
+    def f(xp, cuts):
+        b = (apply_bins_missing(xp, cuts, miss_bin) if missing
+             else apply_bins(xp, cuts))
+        return b.T
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=64)
+def _concat_pieces_fn(n_pieces: int):
+    """Jitted per-device concat of binned ingest pieces along rows —
+    committed inputs keep it on the owning chip (sharded-ingest
+    assembly; peak per-chip HBM ~2× that chip's uint8 slice)."""
+    del n_pieces  # part of the key: one program per piece count
+    return jax.jit(lambda *ps: jnp.concatenate(ps, axis=1))
 
 
 @lru_cache(maxsize=64)
@@ -582,8 +647,7 @@ class HistGBT(_ExternalMemoryEngine):
         G = int(lens.max())
         if p.max_group_size:
             G = min(G, p.max_group_size)
-        ndev = int(np.prod([self.mesh.shape[a]
-                            for a in self.mesh.axis_names]))
+        ndev = device_count(self.mesh)
         Q = len(starts)
         Qp = Q + ((-Q) % ndev)
         Xp = np.zeros((Qp * G, X.shape[1]), np.float32)
@@ -723,6 +787,16 @@ class HistGBT(_ExternalMemoryEngine):
             gbt_metrics()["phase"].observe(self.last_warmup_seconds,
                                            engine="incore", phase="warmup")
 
+        # cross-chip traffic accounting: the per-level histogram sync is
+        # the ONLY collective in the round program, and it runs inside
+        # the jitted dispatch where host instrumentation can't see it —
+        # record the analytic per-round byte bill instead (the model
+        # bench.py's hist_psum_bytes_per_round shares)
+        dsize = int(self.mesh.shape["data"])
+        psum_round_bytes = (hist_psum_bytes_per_round(
+            p.max_depth, n_features, p.n_bins) * max(p.num_class, 1)
+            if dsize > 1 else 0)
+
         t0 = get_time()
         chunks: List[Any] = []
         done = 0
@@ -762,6 +836,10 @@ class HistGBT(_ExternalMemoryEngine):
                     engine="incore", phase="round")
                 m["rounds"].inc(k, engine="incore")
                 m["trees"].inc(k, engine="incore")
+                if psum_round_bytes:
+                    from dmlc_core_tpu.parallel import collectives as coll
+                    coll.record_hist_psum(k * psum_round_bytes,
+                                          engine="incore")
             if chunk_callback is not None:
                 chunk_callback(*self.last_chunk_times[-1])
             self.trees.extend(
@@ -819,13 +897,39 @@ class HistGBT(_ExternalMemoryEngine):
                       f"present to enable the learned default "
                       f"direction, or impute)")
 
+    def _pad_multiple(self) -> int:
+        """Row-padding granularity: the mesh device count, coarsened to
+        the deterministic-histogram block count when ``DMLC_HIST_BLOCKS``
+        is on (every block must have the same row count on every mesh
+        shape, so rows pad to an lcm(devices, blocks) multiple)."""
+        ndev = device_count(self.mesh)
+        blocks = _hist_blocks(int(self.mesh.shape["data"]))
+        if blocks:
+            return int(np.lcm(ndev, blocks))
+        return ndev
+
+    def _sharded_ingest_ok(self) -> bool:
+        """True when ingest may stage per-chip shard slabs directly onto
+        their owning devices (``DMLC_SHARDED_INGEST``, default on).
+        Requires a single-process mesh whose rows shard over ``data``
+        alone (every other axis size 1): per-device placement of row
+        blocks is only well-defined when block ``k`` lives on exactly
+        device ``k``.  The fallback — one global ``device_put`` per
+        chunk — is bit-identical, just staged through jax's global-array
+        path instead."""
+        if os.environ.get("DMLC_SHARDED_INGEST", "1") == "0":
+            return False
+        ndev = device_count(self.mesh)
+        if ndev != int(self.mesh.shape["data"]):
+            return False
+        return not self._mesh_spans_processes()
+
     def _pad_rows(self, X, y, weight):
-        """Pad rows to a mesh-size multiple and build the weight mask
+        """Pad rows to a mesh-size multiple (a block multiple in
+        deterministic-histogram mode) and build the weight mask
         (pad rows weigh 0, so they are invisible to cuts/grads/hists)."""
         n = len(y)
-        ndev = int(np.prod([self.mesh.shape[a]
-                            for a in self.mesh.axis_names]))
-        n_pad = (-n) % ndev
+        n_pad = (-n) % self._pad_multiple()
         if n_pad:
             X = np.concatenate([X, np.zeros((n_pad, X.shape[1]),
                                             np.float32)])
@@ -883,8 +987,7 @@ class HistGBT(_ExternalMemoryEngine):
         the whole-matrix path (pinned by tests/test_compile_cache.py).
         """
         n = X.shape[0]
-        ndev = int(np.prod([self.mesh.shape[a]
-                            for a in self.mesh.axis_names]))
+        ndev = device_count(self.mesh)
         chunk = _ingest_chunk_rows(ndev)
         if chunk <= 0 or n <= chunk:
             bins = self._bin_matrix(jax.device_put(X, mat_sharding))
@@ -929,6 +1032,249 @@ class HistGBT(_ExternalMemoryEngine):
             pieces.append(self._bin_matrix(inflight.popleft()))
         return (pieces[0] if len(pieces) == 1
                 else jnp.concatenate(pieces, axis=0))
+
+    # ------------------------------------------------------------------
+    # sharded ingest: per-chip slab staging (multi-chip data plane)
+    # ------------------------------------------------------------------
+    def _slab_stream(self, X: np.ndarray):
+        """Yield ``X`` in ``DMLC_INGEST_CHUNK_ROWS`` row slabs (one slab
+        when streaming is disabled) — the in-memory adapter feeding
+        :meth:`_ingest_slabs_sharded`."""
+        chunk = _ingest_chunk_rows(1) or len(X)
+        for lo in range(0, len(X), chunk):
+            yield X[lo:lo + chunk]
+
+    def _ingest_slabs_sharded(self, slabs, n_real: int, n_padded: int,
+                              n_features: int,
+                              binned: bool = False) -> jax.Array:
+        """Stream f32 row slabs into the feature-major ``[F, n_padded]``
+        uint8 bin matrix, placed PER CHIP: device ``k`` owns global rows
+        ``[k·S, (k+1)·S)`` (``S = n_padded / ndev``), every slab is cut
+        on those boundaries (:func:`~dmlc_core_tpu.data.iter.
+        slab_shard_slices` — the ``nrows % (chips·chunk)`` tail math),
+        and each piece is put — and on the device-bin route, binned —
+        only on its owning chip.  Rows past ``n_real`` zero-fill (pad
+        rows weigh 0).  The assembled global array
+        (:func:`~dmlc_core_tpu.data.device_feed.assemble_row_sharded`)
+        is byte-identical to a whole-matrix put, but no single device —
+        and, given a slab iterator, no single HOST allocation — ever
+        holds more than its own slice plus one slab: datasets larger
+        than one chip's HBM stream straight onto the mesh
+        (doc/performance.md "Multi-chip data parallelism").
+
+        ``binned=True`` means the slabs arrive as ``[F, rows]`` uint8
+        already (the external engine's page route) and are placed
+        without re-binning."""
+        ndev = device_count(self.mesh)
+        CHECK_EQ(n_padded % ndev, 0, "padded rows must divide the mesh")
+        S = n_padded // ndev
+        devs = list(np.asarray(self.mesh.devices).flat)
+        host_bin = binned or _host_bin_requested() or (
+            self._missing and self._mesh_spans_processes())
+        cuts_np = (np.asarray(self.cuts)
+                   if host_bin and not binned else None)
+        bin_fn = (None if host_bin
+                  else _bin_piece_fn(self._missing, self._miss_bin()))
+        cuts_dev = None if host_bin else jnp.asarray(self.cuts)
+        pieces: List[List[Any]] = [[] for _ in range(ndev)]
+        counts = [0] * ndev
+        inflight: deque = deque()
+        lo = 0
+        for X_slab in slabs:
+            L = X_slab.shape[1] if binned else len(X_slab)
+            CHECK(lo + L <= n_real,
+                  f"slab stream produced more than the declared "
+                  f"{n_real} rows")
+            if host_bin:
+                b_slab = (np.asarray(X_slab) if binned else _host_bin_t(
+                    np.ascontiguousarray(X_slab, np.float32), cuts_np,
+                    missing=self._missing))                   # [F, L]
+                for k, s_lo, s_hi, _dst in slab_shard_slices(lo, L, S):
+                    pieces[k].append(jax.device_put(
+                        np.ascontiguousarray(b_slab[:, s_lo:s_hi]),
+                        devs[k]))
+                    counts[k] += s_hi - s_lo
+            else:
+                for k, s_lo, s_hi, _dst in slab_shard_slices(lo, L, S):
+                    xp = jax.device_put(np.ascontiguousarray(
+                        X_slab[s_lo:s_hi], dtype=np.float32), devs[k])
+                    inflight.append((k, xp))
+                    counts[k] += s_hi - s_lo
+                    if len(inflight) >= 2:   # keep one H2D put in flight
+                        kq, xq = inflight.popleft()
+                        pieces[kq].append(bin_fn(xq, cuts_dev))
+            lo += L
+        CHECK_EQ(lo, n_real, "slab stream ended before the declared rows")
+        while inflight:
+            kq, xq = inflight.popleft()
+            pieces[kq].append(bin_fn(xq, cuts_dev))
+        # pad-tail fill: pad ROWS are zero features, so the f32 routes
+        # bin them through the cuts (bin-of-0.0 per feature) exactly
+        # like make_device_data's padded matrix — the handles stay
+        # byte-identical; pre-binned page slabs pad with bin 0, matching
+        # the external engine's jnp.pad.  Either way pad rows weigh 0.
+        pad_col = None
+        if any(c < S for c in counts):
+            pad_col = (np.zeros((n_features, 1), np.uint8) if binned
+                       else _host_bin_t(
+                           np.zeros((1, n_features), np.float32),
+                           np.asarray(self.cuts),
+                           missing=self._missing))
+        for k in range(ndev):
+            if counts[k] < S:
+                pieces[k].append(jax.device_put(
+                    np.ascontiguousarray(np.repeat(
+                        pad_col, S - counts[k], axis=1)), devs[k]))
+        per_dev = [p[0] if len(p) == 1 else _concat_pieces_fn(len(p))(*p)
+                   for p in pieces]
+        return assemble_row_sharded(per_dev, self.mesh, dim=1, axis="data")
+
+    def make_device_data_iter(
+        self,
+        slab_source: Any,
+        n_features: Optional[int] = None,
+        cuts: Optional[jax.Array] = None,
+        n_rows: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Out-of-core sharded ingest: build a :meth:`fit_device` handle
+        from a STREAM of dense ``(X, y, w)`` slabs without ever
+        materializing the dataset on host or on any single chip — the
+        100M+-row path where the binned matrix exceeds one chip's HBM
+        but fits the mesh's.
+
+        ``slab_source`` is a callable returning a fresh iterator of
+        ``(X [rows, F] f32, y [rows], w [rows] | None)`` numpy slabs in
+        global row order (e.g. ``lambda: iter_dense_slabs(
+        RowBlockIter.create("big.libsvm#cache.bin"), F, chunk)`` — the
+        DiskRowIter/input_split page pipeline), or a plain iterable when
+        ``cuts``, ``n_rows`` and ``n_features`` are all given (a
+        one-pass ingest).  Without ``cuts`` a first streaming pass runs
+        the bounded-memory quantile sketch (merged across workers like
+        :meth:`fit_external`); the second pass bins each slab and places
+        every piece on its owning chip only
+        (:meth:`_ingest_slabs_sharded`).
+
+        The handle is bit-compatible with :meth:`make_device_data`: the
+        same global rows produce the same binned matrix, so trees grown
+        from either handle are identical (pinned by
+        tests/test_multichip.py and scripts/check_multichip.py).
+        NaN/missing mode is not supported on this path (same contract
+        as :meth:`fit_external`): impute before streaming or use
+        :meth:`fit`.
+        """
+        from dmlc_core_tpu.ops.quantile import SketchAccumulator
+
+        p = self.param
+        t_bin = get_time()
+        CHECK(not self._missing,
+              "make_device_data_iter: streamed ingest does not support "
+              "missing mode (NaN bin) — impute, or fit in-core")
+        CHECK(not self._mesh_spans_processes(),
+              "make_device_data_iter: per-chip placement needs a "
+              "single-process mesh (each process stages only local "
+              "devices) — use fit_external for multi-worker jobs")
+        CHECK_EQ(device_count(self.mesh), int(self.mesh.shape["data"]),
+                 "make_device_data_iter: rows must shard over 'data' "
+                 "alone (every other mesh axis size 1)")
+        two_pass = cuts is None and self.cuts is None
+        if two_pass or n_rows is None or n_features is None:
+            CHECK(callable(slab_source),
+                  "make_device_data_iter: slab_source must be a "
+                  "callable (re-iterable) unless cuts, n_rows and "
+                  "n_features are all provided")
+
+        # -- pass 1 (when needed): streaming sketch + row count --------
+        if cuts is not None:
+            self.cuts = cuts
+        if self.cuts is None or n_rows is None or n_features is None:
+            sketch: Optional[SketchAccumulator] = None
+            count = 0
+            F_seen = n_features or 0
+            for X_s, y_s, w_s in slab_source():
+                # real copies (np.array): slab sources may yield views
+                # of a reused buffer, and the sketch's device ops
+                # consume the slab asynchronously
+                X_s = np.array(X_s, dtype=np.float32)
+                CHECK(not np.isnan(X_s).any(),
+                      "make_device_data_iter: NaN features are only "
+                      "supported by the in-core fit — impute before "
+                      "streaming")
+                F_seen = max(F_seen, X_s.shape[1])
+                count += len(X_s)
+                if self.cuts is None:
+                    if sketch is None:
+                        sketch = SketchAccumulator(
+                            X_s.shape[1], n_summary=max(8 * p.n_bins, 64))
+                    sketch.add(X_s, self._fold_scale_pos_weight(
+                        np.array(y_s, dtype=np.float32),
+                        None if w_s is None
+                        else np.array(w_s, dtype=np.float32)))
+            CHECK(count > 0, "make_device_data_iter: empty input")
+            n_rows = count if n_rows is None else n_rows
+            CHECK_EQ(n_rows, count, "declared n_rows != streamed rows")
+            n_features = F_seen
+            if self.cuts is None:
+                self.cuts = sketch.finalize(
+                    p.n_bins, allgather_fn=self._maybe_allgather())
+        F = int(n_features)
+        CHECK_EQ(int(self.cuts.shape[0]), F,
+                 "cuts width does not match the streamed feature count")
+        CHECK_EQ(int(self.cuts.shape[1]), p.n_bins - 1,
+                 "cuts must be standard mode (n_bins-1 boundaries) for "
+                 "the streamed ingest")
+
+        n = int(n_rows)
+        n_pad = (-n) % self._pad_multiple()
+        n_padded = n + n_pad
+        # compile the round ladder while the ingest streams below (the
+        # cold-start overlap — same handle fit()/fit_device join)
+        self._maybe_start_warmup(F, n_padded)
+
+        # -- pass 2: stream bins per chip, accumulate y/w on host ------
+        ys: List[np.ndarray] = []
+        ws: List[np.ndarray] = []
+
+        def x_slabs():
+            for X_s, y_s, w_s in (slab_source() if callable(slab_source)
+                                  else slab_source):
+                # REAL copy, not ascontiguousarray: slab sources may
+                # yield views of a reused staging buffer
+                # (iter_dense_slabs' contract), and device_put can
+                # alias host memory on the CPU backend — an in-flight
+                # async H2D piece must never see the next slab's bytes
+                X_s = np.array(X_s, dtype=np.float32)
+                y_np = np.array(y_s, dtype=np.float32)
+                if p.num_class > 1 and len(y_np):
+                    CHECK(y_np.min() >= 0 and y_np.max() < p.num_class,
+                          f"multi:softmax labels must be in "
+                          f"[0, {p.num_class})")
+                ys.append(y_np)
+                ws.append(self._fold_scale_pos_weight(
+                    y_np, np.ones(len(y_np), np.float32) if w_s is None
+                    else np.array(w_s, dtype=np.float32)))
+                yield X_s
+
+        bins_t = self._ingest_slabs_sharded(x_slabs(), n, n_padded, F)
+        y = np.concatenate(ys) if len(ys) > 1 else ys[0]
+        mask = np.concatenate(ws) if len(ws) > 1 else ws[0]
+        CHECK_EQ(len(y), n, "slab stream row count changed between passes")
+        if n_pad:
+            y = np.concatenate([y, np.zeros(n_pad, np.float32)])
+            mask = np.concatenate([mask, np.zeros(n_pad, np.float32)])
+        row_sharding = NamedSharding(self.mesh, P("data"))
+        out = {
+            "bins_t": bins_t,
+            "y_d": jax.device_put(y, row_sharding),
+            "w_d": jax.device_put(mask, row_sharding),
+            "n": n,
+            "n_padded": n_padded,
+            "n_features": F,
+        }
+        self.last_bin_seconds = get_time() - t_bin
+        if _metrics.enabled():
+            gbt_metrics()["phase"].observe(self.last_bin_seconds,
+                                           engine="incore", phase="bin")
+        return out
 
     # ------------------------------------------------------------------
     # reusable device-resident training data (DMatrix analogy)
@@ -1024,9 +1370,7 @@ class HistGBT(_ExternalMemoryEngine):
         # pinned (cuts mode, shapes, params) — start compiling it in
         # the background so XLA works while the binning + H2D staging
         # below runs (the cold-start overlap; _boost_binned joins)
-        ndev = int(np.prod([self.mesh.shape[a]
-                            for a in self.mesh.axis_names]))
-        self._maybe_start_warmup(F, n + ((-n) % ndev))
+        self._maybe_start_warmup(F, n + ((-n) % self._pad_multiple()))
         X, y, mask, n_pad = self._pad_rows(X, y, weight)
 
         row_sharding = NamedSharding(self.mesh, P("data"))
@@ -1039,8 +1383,19 @@ class HistGBT(_ExternalMemoryEngine):
         # outweighs the transfer saving HERE, so the knob stays opt-in
         # for hosts with cores or slower links; default (unset) is the
         # device path.
-        if _host_bin_requested() or (self._missing
-                                     and self._mesh_spans_processes()):
+        if self._sharded_ingest_ok() and device_count(self.mesh) > 1:
+            # SHARDED ingest (the multi-chip staging path): each chip
+            # receives — and, on the device-bin route, bins — exactly
+            # its own row slice, streamed slab by slab; the matrix is
+            # never resident on a single device and never staged
+            # through a global put.  Binning is per-element and the
+            # final layout is the same P(None, "data") block layout, so
+            # the result is bit-identical to both fallback paths
+            # (pinned by tests/test_multichip.py).
+            bins_t = self._ingest_slabs_sharded(
+                self._slab_stream(X), len(X), len(X), F)
+        elif _host_bin_requested() or (self._missing
+                                       and self._mesh_spans_processes()):
             # missing + process-spanning mesh ALWAYS bins on host:
             # jax's cross-process device_put consistency assert
             # compares the global array with == and NaN != NaN, so an
@@ -1155,7 +1510,8 @@ class HistGBT(_ExternalMemoryEngine):
                 p.min_child_weight,
                 p.hist_method, obj_key, mono, p.subsample,
                 p.colsample_bytree, p.num_class, self._missing,
-                os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"))
+                os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"),
+                _hist_blocks(int(self.mesh.shape["data"])))
 
     def _build_round_fn(self, n_features: int, n_rounds: int = 1):
         """Jitted shard_map program running ``n_rounds`` boosting rounds
@@ -1213,6 +1569,12 @@ class HistGBT(_ExternalMemoryEngine):
         # v5e (see ops.fused_descend_histogram); env knob for other HW
         fuse_levels = bool(int(
             os.environ.get("DMLC_TPU_FUSED_DESCEND", "0")))
+        # deterministic shard-invariant reduction (DMLC_HIST_BLOCKS, see
+        # _hist_blocks): fixed global row blocks + fixed-order folds +
+        # all_gather instead of psum, so the grown trees are
+        # bit-identical across mesh shapes (the single-chip oracle)
+        dsize = int(self.mesh.shape["data"])
+        det_blocks = _hist_blocks(dsize)
 
         def table_select(table, node, n_entries):
             """Gather-free ``table[node]`` for a tiny per-node table: a
@@ -1264,6 +1626,27 @@ class HistGBT(_ExternalMemoryEngine):
             (ops.fused_descend_histogram) — the bin tile is read from
             HBM once per level instead of twice."""
             node = jnp.zeros(bins_tl.shape[1], jnp.int32)
+            n_local = int(bins_tl.shape[1])
+            # blocked mode needs every shard's rows to split into whole
+            # fixed-size blocks; _pad_rows guarantees it for fit paths,
+            # the ranking regroup (group-padded layout) falls back
+            c_local = det_blocks // dsize if det_blocks else 0
+            n_blk = (c_local if c_local and n_local % c_local == 0
+                     else 0)
+            rb = n_local // n_blk if n_blk else 0
+
+            def hist_sync(x):
+                """Histogram-sync allreduce over the data axis: a plain
+                psum normally; in deterministic mode an all_gather (no
+                arithmetic) + the same fixed-order fold the per-shard
+                partials used, so total = the one mesh-invariant tree."""
+                if not n_blk:
+                    return jax.lax.psum(x, "data")
+                if dsize == 1:
+                    return x
+                gathered = jax.lax.all_gather(x, "data")   # [dsize, ...]
+                return _tree_fold([gathered[i] for i in range(dsize)])
+
             feats = []
             thrs = []
             gains = []
@@ -1278,21 +1661,47 @@ class HistGBT(_ExternalMemoryEngine):
             for level in range(depth):
                 n_nodes = 1 << level
                 if level == 0:
-                    hist = build_histogram(bins_tl, node, g, h, 1, B,
-                                           method, transposed=True)
-                    hist = jax.lax.psum(hist, "data")
+                    if n_blk:
+                        hist = _tree_fold([
+                            build_histogram(
+                                bins_tl[:, j * rb:(j + 1) * rb],
+                                node[j * rb:(j + 1) * rb],
+                                g[j * rb:(j + 1) * rb],
+                                h[j * rb:(j + 1) * rb],
+                                1, B, method, transposed=True)
+                            for j in range(n_blk)])
+                    else:
+                        hist = build_histogram(bins_tl, node, g, h, 1, B,
+                                               method, transposed=True)
+                    hist = hist_sync(hist)
                 else:
                     n_prev = n_nodes >> 1
                     feat_sel = table_select(feat, node, n_prev)       # [n]
                     thr_sel = table_select(thr, node, n_prev)         # [n]
                     dir_sel = (table_select(dirv, node, n_prev)
                                if missing else None)
-                    left, node = fused_descend_histogram(
-                        bins_tl, node, feat_sel, thr_sel, g, h,
-                        n_prev, B, method, fuse=fuse_levels,
-                        dir_sel=dir_sel,
-                        miss_bin=B - 1 if missing else None)
-                    left = jax.lax.psum(left, "data")
+                    if n_blk:
+                        lefts, nodes2 = [], []
+                        for j in range(n_blk):
+                            sl = slice(j * rb, (j + 1) * rb)
+                            l_j, nd_j = fused_descend_histogram(
+                                bins_tl[:, sl], node[sl], feat_sel[sl],
+                                thr_sel[sl], g[sl], h[sl],
+                                n_prev, B, method, fuse=fuse_levels,
+                                dir_sel=(None if dir_sel is None
+                                         else dir_sel[sl]),
+                                miss_bin=B - 1 if missing else None)
+                            lefts.append(l_j)
+                            nodes2.append(nd_j)
+                        left = _tree_fold(lefts)
+                        node = jnp.concatenate(nodes2)
+                    else:
+                        left, node = fused_descend_histogram(
+                            bins_tl, node, feat_sel, thr_sel, g, h,
+                            n_prev, B, method, fuse=fuse_levels,
+                            dir_sel=dir_sel,
+                            miss_bin=B - 1 if missing else None)
+                    left = hist_sync(left)
                     right = prev_hist - left
                     hist = jnp.stack([left, right], axis=2).reshape(
                         2, n_nodes, left.shape[2], B)

@@ -338,32 +338,49 @@ class _ExternalMemoryEngine:
         that transient doesn't fit, use ``cache_device=False``.
         """
         p = self.param
-        ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         y = np.concatenate([pg["y"] for pg in pages])
         w = np.concatenate([pg["w"] for pg in pages])
         n = len(y)
-        n_pad = (-n) % ndev
+        n_pad = (-n) % self._pad_multiple()
         # overlap the round-program compile with the page concat +
         # upload below (same handle fit()/fit_device use; see
         # histgbt._RoundProgramWarmup — _boost_binned joins it)
         self._maybe_start_warmup(F, n + n_pad)
-        if isinstance(pages[0]["bins"], np.ndarray):
-            # host pages (auto-residency route): concatenate on host so
-            # the device sees ONE upload, not one per page — a remote
-            # tunnel charges per-transfer latency ~365 times otherwise
-            bins_t = jnp.asarray(
-                np.concatenate([pg["bins"] for pg in pages], axis=1))
+        host_pages = isinstance(pages[0]["bins"], np.ndarray)
+        if host_pages and self._sharded_ingest_ok() \
+                and self.mesh.shape["data"] > 1:
+            # multi-chip sharded staging: stream the binned host pages
+            # through the per-chip ingest — each chip receives only its
+            # own row slice, where the global-put fallback below stages
+            # the FULL matrix through jax's global-array path first.
+            # Binned bytes are placed, not recomputed, so the result is
+            # byte-identical either way.
+            bins_t = self._ingest_slabs_sharded(
+                (pg["bins"] for pg in pages), n, n + n_pad, F,
+                binned=True)
+            pages.clear()
+            if n_pad:
+                y = np.concatenate([y, np.zeros(n_pad, np.float32)])
+                w = np.concatenate([w, np.zeros(n_pad, np.float32)])
         else:
-            bins_t = jnp.concatenate(
-                [jnp.asarray(pg["bins"]) for pg in pages], axis=1)
-        pages.clear()                     # free the per-page device refs
-        if n_pad:
-            bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad)))
-            y = np.concatenate([y, np.zeros(n_pad, np.float32)])
-            w = np.concatenate([w, np.zeros(n_pad, np.float32)])
+            if host_pages:
+                # host pages (auto-residency route): concatenate on host
+                # so the device sees ONE upload, not one per page — a
+                # remote tunnel charges per-transfer latency ~365 times
+                # otherwise
+                bins_t = jnp.asarray(
+                    np.concatenate([pg["bins"] for pg in pages], axis=1))
+            else:
+                bins_t = jnp.concatenate(
+                    [jnp.asarray(pg["bins"]) for pg in pages], axis=1)
+            pages.clear()                 # free the per-page device refs
+            if n_pad:
+                bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad)))
+                y = np.concatenate([y, np.zeros(n_pad, np.float32)])
+                w = np.concatenate([w, np.zeros(n_pad, np.float32)])
+            bins_t = jax.device_put(
+                bins_t, NamedSharding(self.mesh, P(None, "data")))
         row_sharding = NamedSharding(self.mesh, P("data"))
-        bins_t = jax.device_put(
-            bins_t, NamedSharding(self.mesh, P(None, "data")))
         y_d = jax.device_put(y, row_sharding)
         w_d = jax.device_put(w, row_sharding)
         preds = jax.device_put(
@@ -553,6 +570,7 @@ class _ExternalMemoryEngine:
                     hist = ph if hist is None else hist + ph
                 if distributed:
                     hist = coll.allreduce_device(hist)
+                    coll.record_hist_psum(hist.nbytes, engine="external")
                 if level > 0:
                     hist = sib_stack(hist, prev_hist, level=level)
                 prev_hist = hist

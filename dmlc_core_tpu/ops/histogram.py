@@ -42,7 +42,29 @@ from dmlc_core_tpu.base.logging import log_fatal
 
 __all__ = ["build_histogram", "fused_descend_histogram",
            "select_feature_bins", "histogram_methods",
-           "reference_histogram"]
+           "reference_histogram", "hist_psum_bytes_per_round"]
+
+
+def hist_psum_bytes_per_round(depth: int, n_features: int,
+                              n_bins: int) -> int:
+    """Per-chip bytes contributed to the in-step histogram-sync
+    allreduce by ONE boosting round (one tree) of the sibling-subtracted
+    level-wise engine.
+
+    Per level ℓ only the built histograms cross the wire: the root at
+    level 0, then LEFT children only (``n_build = 2^(ℓ-1)``) — sibling
+    subtraction halves the psum payload below the root.  Each built node
+    is ``[2, F, B]`` f32 (grad + hess planes).  This is the single
+    analytic model behind bench.py's ``hist_psum_bytes_per_round`` field
+    and the live ``dmlc_histogram_psum_bytes_total`` counter — the
+    cross-chip traffic the multi-chip flagship pays per round (the
+    rabit-allreduce replacement's byte bill).
+    """
+    total = 0
+    for level in range(depth):
+        n_build = 1 if level == 0 else 1 << (level - 1)
+        total += 2 * n_build * n_features * n_bins * 4
+    return total
 
 # rows per MXU block: one-hot RHS is [R, F·B] bf16 — at F=28, B=256 and
 # R=8192 that is ~117MB, safely inside HBM working set while keeping the

@@ -48,7 +48,7 @@ __all__ = [
     "allreduce", "broadcast", "allgather", "barrier",
     "allreduce_device",
     "device_allreduce", "device_allgather", "device_reduce_scatter",
-    "replicate_fwd_psum_bwd",
+    "replicate_fwd_psum_bwd", "record_hist_psum",
     "get_tree", "find_share_ring", "get_link_map",
 ]
 
@@ -78,8 +78,29 @@ def _coll_metrics():
             "seconds": r.histogram("collective_seconds",
                                    "host-path collective latency",
                                    labels=("op",)),
+            "hist_psum": r.counter(
+                "histogram_psum_bytes_total",
+                "per-chip bytes contributed to in-step histogram-sync "
+                "allreduces (analytic traffic model; XLA hides the "
+                "collective itself from host instrumentation)",
+                labels=("engine",)),
         }
     return _CM
+
+
+def record_hist_psum(nbytes: int, engine: str = "incore") -> None:
+    """Account the histogram-sync psum traffic of a dispatched round
+    program.
+
+    The per-level psum rides INSIDE the jitted shard_map program, so the
+    host-path instrumentation around :func:`allreduce` /
+    :func:`allreduce_device` never sees it — the training engine calls
+    this with the analytic per-dispatch byte count
+    (:func:`~dmlc_core_tpu.ops.histogram.hist_psum_bytes_per_round` ×
+    rounds × output trees) instead.  No-op when metrics are disabled.
+    """
+    if nbytes > 0 and _metrics.enabled():
+        _coll_metrics()["hist_psum"].inc(nbytes, engine=engine)
 
 
 @contextlib.contextmanager

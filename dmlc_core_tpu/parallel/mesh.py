@@ -11,7 +11,7 @@ entire "communication backend" (SURVEY.md §5).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -28,6 +28,9 @@ __all__ = [
     "data_sharding",
     "replicated_sharding",
     "local_mesh",
+    "device_count",
+    "shard_row_ranges",
+    "row_shard_layout",
 ]
 
 # canonical axis order; unused axes get size 1 and cost nothing
@@ -84,6 +87,53 @@ def local_mesh(n: Optional[int] = None, axis: str = "data") -> Mesh:
     """A 1-axis mesh over the first ``n`` devices (test/bench convenience)."""
     devs = jax.devices()[: n or len(jax.devices())]
     return Mesh(np.asarray(devs), axis_names=(axis,))
+
+
+def device_count(mesh: Mesh) -> int:
+    """Total devices in the mesh (the row-padding granularity: rows are
+    sharded over ``data`` and replicated over every other axis, so the
+    padded row count must divide by the full device product)."""
+    return int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+
+def shard_row_ranges(n_rows: int, nparts: int) -> List[Tuple[int, int]]:
+    """Exact row partition over ``nparts`` — the reference's
+    ``InputSplit(part, nparts)`` byte-range contract lifted to row
+    indices: part ``k`` owns rows ``[n·k/nparts, n·(k+1)/nparts)``.
+
+    Tiling invariant (the ``unittest_inputsplit`` oracle, property-pinned
+    in tests/test_multichip.py): for ANY ``(n_rows, nparts)`` — including
+    ``n_rows < nparts`` and odd remainders — the ranges are disjoint,
+    ordered, and their union is exactly ``[0, n_rows)``; no row is
+    dropped or duplicated, and the remainder spreads over parts instead
+    of piling onto the last one.
+    """
+    CHECK(nparts >= 1, f"shard_row_ranges: nparts must be >= 1, got {nparts}")
+    CHECK(n_rows >= 0, f"shard_row_ranges: n_rows must be >= 0, got {n_rows}")
+    return [(n_rows * k // nparts, n_rows * (k + 1) // nparts)
+            for k in range(nparts)]
+
+
+def row_shard_layout(n_rows: int, mesh: Mesh,
+                     pad_multiple: int = 0) -> Tuple[int, int]:
+    """``(n_padded, shard_rows)`` of the device layout rows land in when
+    sharded on the mesh: rows pad to a device-count multiple (or to
+    ``pad_multiple`` when larger — the deterministic-histogram block
+    granularity needs a coarser pad) and device ``k`` owns the equal
+    block ``[k·shard_rows, (k+1)·shard_rows)``.  Unlike
+    :func:`shard_row_ranges` (exact, possibly unequal — a *read*
+    assignment), this is the *placement* math: jax shards are equal by
+    construction, the tail padding weighs 0.
+    """
+    ndev = device_count(mesh)
+    m = max(pad_multiple, ndev)
+    CHECK_EQ(m % ndev, 0,
+             f"pad_multiple {pad_multiple} must be a device-count "
+             f"({ndev}) multiple")
+    n_padded = n_rows + ((-n_rows) % m)
+    if n_padded == 0:
+        n_padded = m
+    return n_padded, n_padded // ndev
 
 
 def data_sharding(mesh: Mesh, ndim: int = 1, axis: str = "data") -> NamedSharding:

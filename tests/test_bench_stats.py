@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import chunk_stats  # noqa: E402
+from bench import chunk_stats, scaling_summary  # noqa: E402
 
 
 class TestChunkStats:
@@ -64,6 +64,31 @@ class TestChunkStats:
         assert at["anomaly"] is False            # ratio == 3.0
         above = chunk_stats([(10, 1.0), (20, 4.2)], 20, 4.2)
         assert above["anomaly"] is True
+
+
+class TestScalingSummary:
+    def test_perfect_linear_scaling(self):
+        s = scaling_summary(8, per_chip_rate=2.0, baseline_rate=2.0)
+        assert s["scaling_efficiency"] == 1.0
+        assert s["aggregate_rounds_per_sec"] == 16.0
+        assert s["chips"] == 8 and s["baseline_chips"] == 1
+
+    def test_issue7_acceptance_bar(self):
+        # 8 chips at 70% of the 1-chip per-chip rate = the 0.7 bar
+        s = scaling_summary(8, per_chip_rate=1.4, baseline_rate=2.0)
+        assert abs(s["scaling_efficiency"] - 0.7) < 1e-9
+        assert s["baseline_rounds_per_sec_per_chip"] == 2.0
+
+    def test_superlinear_allowed(self):
+        # out-of-core relief: N chips can beat N x 1-chip when the
+        # 1-chip run was HBM-thrashing — the summary must not clamp
+        s = scaling_summary(4, per_chip_rate=2.5, baseline_rate=2.0)
+        assert s["scaling_efficiency"] == 1.25
+
+    def test_degenerate_baseline_returns_none(self):
+        assert scaling_summary(8, 2.0, 0.0) is None
+        assert scaling_summary(8, 2.0, None) is None
+        assert scaling_summary(0, 2.0, 2.0) is None
 
 
 class TestPairwiseRankAutodiffOracle:
