@@ -20,15 +20,22 @@ Examples::
 
     DMLC_FAULT_INJECT="http:error=503:p=0.3,stream:truncate:p=0.1"
     DMLC_FAULT_INJECT="checkpoint:kill:after=1"   # 2nd checkpoint dies
+    DMLC_FAULT_INJECT="worker:kill:after=7"       # SIGKILL at round 8
+    DMLC_FAULT_INJECT="allreduce:abort:after=30"  # void the round
     with faultinject.inject("serve:error=503:p=0.5:n=20"): ...
 
 Kinds are interpreted by the injection SITE (the injector only decides
 *whether* to fire): ``error=<status>`` fabricates an HTTP failure,
 ``reset`` a connection reset, ``truncate`` a short ranged-read body,
-``kill`` a SIGKILL of the current process mid-checkpoint, ``abort`` an
-IOError mid-checkpoint, ``corrupt`` a post-commit byte flip, plain
-``error`` a producer exception.  See ``doc/robustness.md`` for the
-per-point table.
+``kill`` a SIGKILL of the current process (mid-checkpoint at the
+``checkpoint`` point, mid-boost at the ``worker`` point — the elastic
+chaos drill's trigger — or mid-collective at ``allreduce``), ``abort``
+an in-flight abort (IOError mid-checkpoint; at ``allreduce`` it voids
+the epoch on EVERY worker — the all-or-nothing round drill), ``corrupt``
+a post-commit byte flip, plain ``error`` a producer exception.  The
+``worker`` point is checked once per boosting round and at each commit,
+so ``worker:kill:after=N`` dies at a deterministic, seed-reproducible
+round.  See ``doc/robustness.md`` for the per-point table.
 
 Determinism: each rule draws from its own ``random.Random`` seeded by
 ``DMLC_FAULT_SEED`` (default 1234) and the rule's index, so a given

@@ -204,6 +204,17 @@ declare("DMLC_FAULT_INJECT", "",
         "disables.", "resilience")
 declare("DMLC_FAULT_SEED", 1234,
         "Seed for the per-rule fault-injection RNG streams.", "resilience")
+declare("DMLC_RECOVERY_STRIDE", 5,
+        "Boosting rounds between round-versioned collective checkpoint "
+        "commits (the elastic-recovery floor granularity).", "resilience")
+declare("DMLC_ELASTIC", "0",
+        "1 re-shards the surviving workers (shrunk world, re-cut row "
+        "shards) once a lost worker's grace lapses; 0 holds the world "
+        "for a rejoining replacement.", "resilience")
+declare("DMLC_RECOVERY_DIR", "",
+        "Directory for per-rank round-versioned recovery checkpoints "
+        "(parallel/recovery); empty requires an explicit "
+        "recovery_dir=.", "resilience")
 
 # -- serving ----------------------------------------------------------------
 declare("DMLC_SERVE_PREWARM", "0",

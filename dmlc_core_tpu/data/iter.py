@@ -18,6 +18,8 @@ from __future__ import annotations
 import contextlib
 from typing import Iterator, Optional
 
+import numpy as np
+
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.data.parsers import Parser, parse_uri_spec
@@ -26,7 +28,7 @@ from dmlc_core_tpu.io.stream import Stream
 from dmlc_core_tpu.io.threaded_iter import ThreadedIter
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
-__all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter",
+__all__ = ["RowBlockIter", "BasicRowIter", "DiskRowIter", "ArrayRowIter",
            "iter_dense_slabs", "slab_shard_slices"]
 
 # target bytes per cache page (reference uses a row-count heuristic; byte
@@ -140,6 +142,57 @@ class BasicRowIter(RowBlockIter):
     @property
     def num_rows(self) -> int:
         return self._block.size
+
+
+class ArrayRowIter(RowBlockIter):
+    """In-memory dense arrays as a rewindable :class:`RowBlockIter`.
+
+    The adapter the elastic recovery layer uses to re-cut row shards
+    over a changing world: ``ArrayRowIter(X[lo:hi], y[lo:hi])`` turns
+    any contiguous row range into the page-stream contract
+    ``fit_external`` consumes, without a serialization round trip.
+    Pages are CSR views of ``page_rows`` rows each (dense: every entry
+    present, so zeros stay explicit and bin identically to the
+    densified parser path).
+    """
+
+    def __init__(self, X, y, weight=None, page_rows: int = 65536):
+        X = np.ascontiguousarray(X, dtype=np.float32)
+        y = np.ascontiguousarray(y, dtype=np.float32)
+        n, F = X.shape
+        self._ncol = F
+        self._pages = []
+        for lo in range(0, max(n, 1), page_rows):
+            hi = min(lo + page_rows, n)
+            rows = hi - lo
+            self._pages.append(RowBlock(
+                offset=np.arange(rows + 1, dtype=np.int64) * F,
+                label=y[lo:hi],
+                index=np.tile(np.arange(F, dtype=np.int64), rows),
+                value=X[lo:hi].reshape(-1),
+                weight=None if weight is None else np.ascontiguousarray(
+                    weight[lo:hi], dtype=np.float32),
+            ))
+        self._n = n
+        self._pos = 0
+
+    def before_first(self) -> None:
+        self._pos = 0
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._pos >= len(self._pages):
+            return None
+        block = self._pages[self._pos]
+        self._pos += 1
+        return block
+
+    @property
+    def num_col(self) -> int:
+        return self._ncol
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
 
 
 class DiskRowIter(RowBlockIter):

@@ -305,6 +305,16 @@ def _transpose_to_feature_major_fn(mesh: Mesh):
         out_shardings=NamedSharding(mesh, P(None, "data")))
 
 
+@lru_cache(maxsize=32)
+def _transpose_from_feature_major_fn(mesh: Mesh):
+    """Inverse of :func:`_transpose_to_feature_major_fn`: ``[F, n] →
+    [n, F]`` with rows back on the data axis — the margin-replay staging
+    a resumed fit (elastic recovery) runs over a device-data handle."""
+    return jax.jit(
+        lambda b: b.T,
+        out_shardings=NamedSharding(mesh, P("data", None)))
+
+
 # shape-keyed caches are BOUNDED: one entry per distinct dataset size,
 # and evicting the jit wrapper drops the last reference to its compiled
 # executables (pre-cache, per-instance closures freed with the instance)
@@ -620,7 +630,8 @@ class HistGBT(_ExternalMemoryEngine):
         preds = self._boost_binned(bins_t, y_d, w_d, preds, F,
                                    eval_every=eval_every,
                                    warmup_rounds=warmup_rounds,
-                                   after_chunk=after_chunk)
+                                   after_chunk=after_chunk,
+                                   round_offset=n_prior)
         self._train_preds = preds
         self._n_real_rows = n
         return self
@@ -679,7 +690,7 @@ class HistGBT(_ExternalMemoryEngine):
 
     def _boost_binned(self, bins_t, y_d, w_d, preds, n_features,
                       eval_every=0, warmup_rounds=0, after_chunk=None,
-                      chunk_callback=None):
+                      chunk_callback=None, round_offset=0):
         """Run ``n_trees`` boosting rounds over device-resident binned
         data (bins feature-major [F, n], rows sharded on the mesh's data
         axis).  Shared by :meth:`fit` and the cached external-memory
@@ -704,11 +715,13 @@ class HistGBT(_ExternalMemoryEngine):
 
         def run(fn, preds_c, done):
             if sampling:
-                # chunk key derives from the round index so a given round
-                # draws the same sample no matter how rounds are chunked
-                # into dispatches within a fixed K
+                # chunk key derives from the GLOBAL round index (prior
+                # rounds included) so a given round draws the same
+                # sample no matter how rounds are chunked into
+                # dispatches — or split across resumed fits (elastic
+                # recovery replays a round with its original draw)
                 return fn(bins_t, y_d, w_d, preds_c,
-                          jax.random.fold_in(base_key, done))
+                          jax.random.fold_in(base_key, round_offset + done))
             return fn(bins_t, y_d, w_d, preds_c)
 
         # join the overlapped compile (make_device_data / fit_device
@@ -1446,12 +1459,18 @@ class HistGBT(_ExternalMemoryEngine):
         device_data: Dict[str, Any],
         warmup_rounds: int = 0,
         chunk_callback: Optional[Any] = None,
+        resume: bool = False,
     ) -> "HistGBT":
-        """Boost ``n_trees`` fresh rounds on a :meth:`make_device_data`
-        handle — the repeated-fit fast path (no re-upload, no re-bin).
+        """Boost ``n_trees`` rounds on a :meth:`make_device_data` handle
+        — the repeated-fit fast path (no re-upload, no re-bin).
 
-        Resets the ensemble (a new fit, not a continuation).  The
-        :meth:`fit`-only extras (eval_set / early stopping / ranking
+        Resets the ensemble (a new fit) unless ``resume=True``, which
+        CONTINUES from the existing trees: the elastic-recovery resume
+        path.  A resumed fit reuses the carried training margins when
+        they match the handle (bit-identical to replay), else replays
+        the ensemble's margins on device, and threads the global round
+        index through so sampling draws match an uninterrupted run.
+        The :meth:`fit`-only extras (eval_set / early stopping / ranking
         regroup) are not available here; use :meth:`fit` for those.
         ``chunk_callback(rounds_fetched, elapsed_s)`` fires as each
         dispatch chunk's trees arrive on host — incremental timing
@@ -1469,19 +1488,49 @@ class HistGBT(_ExternalMemoryEngine):
             # cache makes it a disk read
             self._maybe_start_warmup(device_data["n_features"],
                                      device_data["n_padded"])
-        self.trees = []
+        if resume and self.trees:
+            CHECK(self.cuts is not None, "resume-fit without cuts")
+            n_prior = len(self.trees)
+            preds = self._resume_margin_device(device_data)
+        else:
+            self.trees = []
+            n_prior = 0
+            preds = self._init_margin_device(device_data["n_padded"])
         self.best_iteration = None
         self.best_score = None
         self._early_stopped = False
         self._rank_pos = None
-        preds = self._init_margin_device(device_data["n_padded"])
         preds = self._boost_binned(
             device_data["bins_t"], device_data["y_d"], device_data["w_d"],
             preds, device_data["n_features"],
-            warmup_rounds=warmup_rounds, chunk_callback=chunk_callback)
+            warmup_rounds=warmup_rounds, chunk_callback=chunk_callback,
+            round_offset=n_prior)
         self._train_preds = preds
         self._n_real_rows = device_data["n"]
         return self
+
+    def _resume_margin_device(self, device_data: Dict[str, Any]) -> jax.Array:
+        """Margins of the existing ensemble over the handle's rows.
+
+        Prefers the carried training margins from the previous leg (the
+        same buffer the round program produced — zero work); a restored
+        process has none, so the trees replay on device instead.  Both
+        routes are bit-identical: the replay applies the same leaf
+        values in the same order the incremental updates added them.
+        """
+        n_padded = device_data["n_padded"]
+        carried = self._train_preds
+        if carried is not None and getattr(carried, "shape", (0,))[0] == n_padded:
+            return carried
+        bins = _transpose_from_feature_major_fn(self.mesh)(
+            device_data["bins_t"])
+        init = self._init_margin_device(n_padded)
+        tgt = init.sharding
+        preds = self._apply_trees(bins, self._stacked_trees(self.trees),
+                                  init)
+        if preds.sharding != tgt:
+            preds = jax.device_put(preds, tgt)
+        return preds
 
     # ------------------------------------------------------------------
     # external-memory training (BASELINE config 3)

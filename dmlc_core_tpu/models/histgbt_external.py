@@ -168,7 +168,12 @@ class _ExternalMemoryEngine:
         as 0.0 (XGBoost's dense-hist convention for Criteo-style data).
 
         Trees produced are the same arrays as :meth:`fit`, so
-        :meth:`predict` and checkpointing work unchanged.
+        :meth:`predict` and checkpointing work unchanged.  A model that
+        already holds trees CONTINUES from them (the elastic-recovery
+        resume contract): existing margins replay over the binned pages
+        before the ``n_trees`` additional rounds run, and round-indexed
+        sampling draws use the global round number — a recovery replay
+        reproduces the uninterrupted run's draws.
 
         Device memory contract: bounded by
         ``DMLC_TPU_EXTERNAL_DEVICE_BUDGET`` (bytes, default 6 GiB).
@@ -383,14 +388,29 @@ class _ExternalMemoryEngine:
         row_sharding = NamedSharding(self.mesh, P("data"))
         y_d = jax.device_put(y, row_sharding)
         w_d = jax.device_put(w, row_sharding)
+        margin_sharding = (NamedSharding(self.mesh, P("data", None))
+                           if p.num_class > 1 else row_sharding)
         preds = jax.device_put(
             np.full(self._margin_shape(n + n_pad), p.base_score, np.float32),
-            NamedSharding(self.mesh, P("data", None))
-            if p.num_class > 1 else row_sharding)
+            margin_sharding)
+        n_prior = len(self.trees)
+        if n_prior:
+            # continued fit (elastic-recovery resume): replay the
+            # existing ensemble's margins over the staged bins
+            from dmlc_core_tpu.models.histgbt import (
+                _transpose_from_feature_major_fn)
+
+            bins_rm = _transpose_from_feature_major_fn(self.mesh)(bins_t)
+            preds = self._apply_trees(bins_rm,
+                                      self._stacked_trees(self.trees),
+                                      preds)
+            if preds.sharding != margin_sharding:
+                preds = jax.device_put(preds, margin_sharding)
 
         preds = self._boost_binned(bins_t, y_d, w_d, preds, F,
                                    eval_every=eval_every,
-                                   warmup_rounds=warmup_rounds)
+                                   warmup_rounds=warmup_rounds,
+                                   round_offset=n_prior)
         # same post-fit contract as fit(): train_margins() works after a
         # cache_device external fit too (padding sliced off by the
         # recorded real-row count)
@@ -512,6 +532,19 @@ class _ExternalMemoryEngine:
 
         def chunk_bins(c):
             return bins_d[c] if bins_d is not None else jnp.asarray(bins_h[c])
+
+        n_prior = len(self.trees)
+        if n_prior:
+            # continued fit (elastic-recovery resume): replay the
+            # existing ensemble's margins chunk by chunk — the same
+            # leaf values in the same order the incremental updates
+            # applied them, so a resumed run carries bit-identical
+            # margins into its first new round
+            stacked_prior = self._stacked_trees(self.trees)
+            for c in range(n_chunks):
+                preds_d[c] = self._apply_trees(
+                    jnp.asarray(chunk_bins(c)).T, stacked_prior,
+                    preds_d[c])
 
         # -- round pieces: module-level jits (_ext_*) bound to this fit's
         # config via static kwargs, so compiled programs persist across
@@ -685,7 +718,9 @@ class _ExternalMemoryEngine:
                                            phase="warmup")
 
         t0 = get_time()
-        for r in range(p.n_trees):
+        for r in range(n_prior, n_prior + p.n_trees):
+            # global round index: sampling RNG streams and eval logging
+            # line up with an uninterrupted run when resuming
             t_r = get_time()
             one_round(r, record=True)
             if _metrics.enabled():

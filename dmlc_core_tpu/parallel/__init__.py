@@ -28,6 +28,8 @@ from dmlc_core_tpu.parallel.collectives import (  # noqa: F401
     barrier,
 )
 from dmlc_core_tpu.parallel.kvstore import KVStore  # noqa: F401
+from dmlc_core_tpu.parallel.recovery import (  # noqa: F401
+    ElasticSession, ElasticTracker, ElasticTrainer, RoundCheckpointer)
 from dmlc_core_tpu.parallel.ring_attention import (  # noqa: F401
     reference_attention, ring_attention)
 from dmlc_core_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

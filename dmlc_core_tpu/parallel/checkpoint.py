@@ -245,19 +245,29 @@ def _corrupt_blob(uri: str) -> None:
         out.write(bytes(data))
 
 
-def checkpoint(uri: str, state: Any, version: int = 0, sharded: bool = False) -> None:
+def checkpoint(uri: str, state: Any, version: int = 0, sharded: bool = False,
+               local: bool = False) -> None:
     """Save a pytree of arrays/scalars.  Reference: rabit ``CheckPoint``.
 
     ``sharded=True`` writes one file per process (``uri.shard-K-of-N``),
     each holding only locally-addressable shard data — the multi-host path
     where no single host can materialize the full arrays.
 
+    ``local=True`` skips the collective semantics entirely (no rank-0
+    election, no barrier): THIS caller writes ``uri`` as given.  The
+    elastic recovery layer uses it for per-rank round-versioned commit
+    files, where every worker must write its own file without dragging a
+    collective into the commit path (a dying peer would wedge it).
+
     The write is crash-safe: payload lands in a temp file (or a commit-
     on-close backend stream) and only a complete write replaces ``uri``;
     with retention on (see ``DMLC_CKPT_KEEP``) the replaced version
     survives as ``uri + ".prev"`` for corruption fallback.
     """
-    if sharded and coll.world_size() > 1:
+    if local:
+        payload = jax.tree.map(_to_host, state)
+        payload = jax.tree.flatten(payload)[0]
+    elif sharded and coll.world_size() > 1:
         uri = f"{uri}.shard-{coll.rank()}-of-{coll.world_size()}"
         leaves, treedef = jax.tree.flatten(state)
         host_leaves = []
@@ -300,7 +310,7 @@ def checkpoint(uri: str, state: Any, version: int = 0, sharded: bool = False) ->
     if fault is not None and fault.kind == "corrupt":
         _corrupt_blob(uri)
 
-    if coll.world_size() > 1 and not sharded:
+    if coll.world_size() > 1 and not sharded and not local:
         coll.barrier("ckpt")
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -49,10 +50,42 @@ __all__ = [
     "allreduce_device",
     "device_allreduce", "device_allgather", "device_reduce_scatter",
     "replicate_fwd_psum_bwd", "record_hist_psum",
+    "set_host_transport", "get_host_transport",
     "get_tree", "find_share_ring", "get_link_map",
 ]
 
 _initialized = False
+
+# ---------------------------------------------------------------------------
+# pluggable host-collective transport (rabit wire parity)
+# ---------------------------------------------------------------------------
+# When multi-process XLA collectives are unavailable (the CPU backend
+# refuses multiprocess computations entirely) the elastic recovery layer
+# (``parallel.recovery``) runs the host collectives over the tracker's
+# TCP protocol instead — rabit's actual wire role.  An installed
+# transport overrides rank/world_size and every HOST-path collective in
+# this module; the in-jit device collectives are untouched (they stay
+# mesh-local).  Storage is thread-local so in-process multi-worker
+# harnesses (one worker per thread, each with its own transport+rank)
+# compose — exactly how the drill tests exercise the protocol.
+
+_HOST_TRANSPORT = threading.local()
+
+
+def set_host_transport(transport: Optional[Any]) -> None:
+    """Install (``None`` clears) this thread's host-collective transport.
+
+    A transport duck-types ``rank``/``world`` attributes and
+    ``allreduce(np_array, op)`` / ``allgather(np_array)`` /
+    ``broadcast(value, root)`` / ``barrier(name)`` methods — see
+    ``parallel.recovery.ElasticSession``.
+    """
+    _HOST_TRANSPORT.t = transport
+
+
+def get_host_transport() -> Optional[Any]:
+    """The transport installed on this thread (None = native jax path)."""
+    return getattr(_HOST_TRANSPORT, "t", None)
 
 _REDUCERS = {
     "sum": np.add.reduce,
@@ -182,19 +215,27 @@ def finalize() -> None:
 
 
 def rank() -> int:
-    """This worker's rank.  Reference: rabit ``GetRank`` = process index."""
+    """This worker's rank.  Reference: rabit ``GetRank`` = process index
+    (or the installed host transport's rank)."""
+    t = get_host_transport()
+    if t is not None:
+        return t.rank
     return jax.process_index()
 
 
 def world_size() -> int:
     """Number of workers.  Reference: rabit ``GetWorldSize``."""
+    t = get_host_transport()
+    if t is not None:
+        return t.world
     return jax.process_count()
 
 
 def is_distributed() -> bool:
     """True once :func:`init` has joined a multi-process
-    ``jax.distributed`` cluster (world size > 1)."""
-    return jax.process_count() > 1
+    ``jax.distributed`` cluster (world size > 1) or a host transport
+    spanning multiple workers is installed."""
+    return world_size() > 1
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +256,9 @@ def allreduce(x: np.ndarray, op: str = "sum") -> np.ndarray:
     if op not in _REDUCERS:
         log_fatal(f"allreduce: unknown op {op!r}; valid: {sorted(_REDUCERS)}")
     with _host_op_span("allreduce", x.nbytes):
+        t = get_host_transport()
+        if t is not None:
+            return t.allreduce(x, op)
         if world_size() == 1:
             return x
         from jax.experimental import multihost_utils
@@ -226,6 +270,9 @@ def allreduce(x: np.ndarray, op: str = "sum") -> np.ndarray:
 def broadcast(x: Any, root: int = 0) -> Any:
     """Broadcast a host value from ``root``.  Reference: rabit ``Broadcast``."""
     with _host_op_span("broadcast", getattr(x, "nbytes", 0)):
+        t = get_host_transport()
+        if t is not None:
+            return t.broadcast(x, root)
         if world_size() == 1:
             return x
         from jax.experimental import multihost_utils
@@ -237,6 +284,9 @@ def allgather(x: np.ndarray) -> np.ndarray:
     """Gather arrays from all processes, stacked on axis 0 in rank order."""
     x = np.asarray(x)
     with _host_op_span("allgather", x.nbytes):
+        t = get_host_transport()
+        if t is not None:
+            return t.allgather(x)
         if world_size() == 1:
             return x[None]
         from jax.experimental import multihost_utils
@@ -247,6 +297,10 @@ def allgather(x: np.ndarray) -> np.ndarray:
 def barrier(name: str = "dmlc") -> None:
     """Cross-process barrier (rabit's implicit sync points, made explicit)."""
     with _host_op_span("barrier", 0):
+        t = get_host_transport()
+        if t is not None:
+            t.barrier(name)
+            return
         if world_size() == 1:
             return
         from jax.experimental import multihost_utils
@@ -279,7 +333,14 @@ def allreduce_device(x: jax.Array) -> jax.Array:
     host, allgather, and re-reduce in numpy every level.  Each process
     contributes its value once (staged on its first local device; other
     local devices contribute zeros), so multi-device processes are safe.
+
+    With a host transport installed this degrades to a host round trip
+    (fetch → tracker-mediated deterministic sum → device) — the rabit
+    wire path for backends without multiprocess XLA collectives.
     """
+    t = get_host_transport()
+    if t is not None:
+        return jnp.asarray(t.allreduce(np.asarray(x), "sum"))
     if world_size() == 1:
         return x
     if _metrics.enabled():

@@ -97,6 +97,11 @@ class KVStore:
         self._bucket_bytes = bucket_bytes
         #: observability for tests/benches: collective launches vs keys
         self.stats = {"sync_calls": 0, "keys_synced": 0}
+        # bounded-staleness recovery (rabit's round-version protocol
+        # applied to the PS surface): see enable_recovery()
+        self._rec_uri: Optional[str] = None
+        self._rec_stride = 0
+        self._pull_rounds = 0
         self._updater: Callable[[Key, jax.Array, jax.Array], jax.Array] = (
             lambda key, grad, value: value - self._lr * grad
         )
@@ -153,8 +158,60 @@ class KVStore:
             grads = self._sync_bucketed(grads)
         for k in pend:
             self._store[k] = self._updater(k, grads[k], self._store[k])
+        if pend:
+            self._pull_rounds += 1
+            if (self._rec_uri and self._rec_stride
+                    and self._pull_rounds % self._rec_stride == 0):
+                self._snapshot()
         out = [self._store[k] for k in key_list]
         return out[0] if single else out
+
+    # -- bounded-staleness recovery (ps-lite's role, rabit's protocol) ---
+    def enable_recovery(self, uri: str, stride: Optional[int] = None) -> None:
+        """Round-versioned store snapshots every ``stride`` gradient-
+        applying pulls (default ``DMLC_RECOVERY_STRIDE``), through the
+        atomic CRC'd checkpoint writer — the bounded-staleness recovery
+        mode for GBLinear/FM parameter-server training: a restarted
+        worker :meth:`restore_recovery`-s at most ``stride`` updates
+        behind the last applied state.  Only rank 0 writes (values are
+        identical on every worker after the allreduce); the write is
+        ``local`` (no barrier), so a dying peer can never wedge a
+        snapshot — that is what keeps the staleness *bounded* instead
+        of synchronous.
+        """
+        if stride is None:
+            from dmlc_core_tpu.base import knobs as _knobs
+
+            stride = int(_knobs.value("DMLC_RECOVERY_STRIDE"))
+        CHECK(stride >= 1, f"recovery stride must be >= 1, got {stride}")
+        self._rec_uri = uri
+        self._rec_stride = stride
+
+    def _snapshot(self) -> None:
+        from dmlc_core_tpu.parallel.checkpoint import checkpoint
+
+        if coll.rank() == 0:
+            state = {str(k): np.asarray(v) for k, v in self._store.items()}
+            checkpoint(self._rec_uri, state, version=self._pull_rounds,
+                       local=True)
+
+    def restore_recovery(self, uri: Optional[str] = None) -> int:
+        """Load the newest snapshot into the store (keys must already be
+        :meth:`init`-ed — shapes/dtypes come from the live values).
+        Returns the snapshot's pull-round version, 0 when none exists;
+        the caller replays at most ``stride`` pulls of updates."""
+        from dmlc_core_tpu.parallel.checkpoint import load_checkpoint
+
+        uri = uri or self._rec_uri
+        CHECK(uri is not None, "restore_recovery: no snapshot URI")
+        like = {str(k): np.asarray(self._store[k]) for k in self._store}
+        version, state = load_checkpoint(uri, like)
+        if version:
+            by_name = {str(k): k for k in self._store}
+            for name, value in state.items():
+                self._store[by_name[name]] = jnp.asarray(value)
+            self._pull_rounds = int(version)
+        return int(version)
 
     def _sync_bucketed(self, grads: Dict[Key, jax.Array]) -> Dict[Key, jax.Array]:
         """Allreduce pending grads in fused buckets; returns synced grads."""

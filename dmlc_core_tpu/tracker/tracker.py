@@ -45,6 +45,15 @@ def _tracker_metrics():
             "events": r.counter("tracker_worker_events_total",
                                 "worker lifecycle events",
                                 labels=("event",)),
+            "deaths": r.counter("worker_deaths_total",
+                                "persistent workers lost past recovery "
+                                "decisions: reconnected inside the grace "
+                                "window (rejoined) or declared dead "
+                                "(evicted)",
+                                labels=("outcome",)),
+            "floor": r.gauge("recovery_floor_round",
+                             "last globally-committed boosting round (the "
+                             "elastic-recovery resume floor)"),
         }
     return _TM
 
@@ -98,6 +107,16 @@ class RabitTracker:
         self._free_ranks: List[int] = []             # ranks freed by death
         self.dead_workers: List[int] = []            # death history (ranks)
         self._pending_death: Dict[int, float] = {}   # rank -> grace deadline
+        #: deadline-driven grace expiry: lazy expiry (on message arrival)
+        #: left a silent cluster blind to lapsed deadlines — this timer
+        #: fires at the earliest pending deadline so ``lost_ranks()`` /
+        #: ``dead_workers`` stay accurate through quiet training rounds
+        self._grace_timer: Optional[threading.Timer] = None
+        # recovery-floor bookkeeping (rabit's CheckPoint version_number
+        # consensus): per-rank last durably-committed boosting round, and
+        # the floor = the highest round committed by EVERY expected rank
+        self._commits: Dict[int, int] = {}
+        self._floor = 0
 
     # -- env ABI ---------------------------------------------------------
     def slave_envs(self) -> Dict[str, str]:
@@ -183,8 +202,10 @@ class RabitTracker:
                 if self.grace_s > 0:
                     # reserve the rank: a reconnect inside the window is a
                     # blip, not a death — the rank is handed out again only
-                    # after the grace deadline lapses (lazy expiry)
+                    # after the grace deadline lapses (checked lazily on
+                    # message arrival AND by the armed deadline timer)
                     self._pending_death[rank] = get_time() + self.grace_s
+                    self._arm_grace_timer_locked()
                     _worker_event("lost", rank)
                     LOG("WARNING", "tracker: worker rank %d lost (socket "
                         "closed without shutdown); holding rank for %.1fs "
@@ -193,8 +214,13 @@ class RabitTracker:
                     self.dead_workers.append(rank)
                     self._free_ranks.append(rank)
                     _worker_event("death", rank)
+                    if _metrics.enabled():
+                        _tracker_metrics()["deaths"].inc(1, outcome="evicted")
                     LOG("WARNING", "tracker: worker rank %d died (socket closed "
                         "without shutdown); rank freed for recovery", rank)
+            self._membership_event_locked(
+                "death" if not state["clean"] and self.grace_s <= 0
+                else ("lost" if not state["clean"] else "shutdown"), rank)
 
     def _expire_graces_locked(self) -> None:
         """Flush lapsed grace reservations into the death history + free
@@ -207,8 +233,73 @@ class RabitTracker:
             self.dead_workers.append(rank)
             self._free_ranks.append(rank)
             _worker_event("death", rank)
+            if _metrics.enabled():
+                _tracker_metrics()["deaths"].inc(1, outcome="evicted")
             LOG("WARNING", "tracker: worker rank %d grace expired; rank "
                 "freed for recovery", rank)
+            self._membership_event_locked("death", rank)
+
+    def _arm_grace_timer_locked(self) -> None:
+        """(Re)schedule the deadline-driven expiry sweep at the earliest
+        pending grace deadline.  Without it a cluster that goes silent
+        (no tracker traffic during long training rounds) never notices a
+        lapsed deadline until the next message arrives — the lazy-expiry
+        bug: ``lost_ranks()``/``dead_workers`` were stale exactly when a
+        recovery decision needed them.  Caller holds ``_lock``."""
+        if self._grace_timer is not None:
+            self._grace_timer.cancel()
+            self._grace_timer = None
+        if not self._pending_death or self._done.is_set():
+            return
+        delay = max(0.0, min(self._pending_death.values()) - get_time())
+        t = threading.Timer(delay + 0.005, self._on_grace_deadline)
+        t.daemon = True
+        self._grace_timer = t
+        t.start()
+
+    def _on_grace_deadline(self) -> None:
+        with self._lock:
+            self._expire_graces_locked()
+            self._arm_grace_timer_locked()
+
+    def _membership_event_locked(self, kind: str, rank: int) -> None:
+        """Hook: liveness changed (``lost``/``death``/``reconnect``/
+        ``shutdown``).  Called with ``_lock`` held from the disconnect
+        handler, the grace-expiry sweep, and the recover path; the base
+        tracker does nothing — the elastic recovery layer
+        (``parallel.recovery.ElasticTracker``) overrides it to abort
+        in-flight collectives and re-form the worker group."""
+
+    # -- recovery floor (rabit CheckPoint version consensus) -------------
+    def _expected_ranks_locked(self) -> List[int]:
+        """Ranks whose commits gate the recovery floor — the full
+        configured world by default (an elastic subclass narrows this to
+        the current epoch's members)."""
+        return list(range(self.nworker))
+
+    def _record_commit_locked(self, rank: int, round_no: int) -> int:
+        self._commits[rank] = max(self._commits.get(rank, 0), int(round_no))
+        expected = self._expected_ranks_locked()
+        floor = min((self._commits.get(r, 0) for r in expected), default=0)
+        if floor > self._floor:
+            self._floor = floor
+            if _metrics.enabled():
+                _tracker_metrics()["floor"].set(floor)
+        return self._floor
+
+    def record_commit(self, rank: int, round_no: int) -> int:
+        """Record that ``rank`` durably committed ``round_no`` (its
+        round-versioned checkpoint hit disk) and return the new recovery
+        floor: the highest round committed by EVERY expected rank — the
+        round a dead worker can rejoin from with nothing lost."""
+        with self._lock:
+            return self._record_commit_locked(rank, round_no)
+
+    def recovery_floor(self) -> int:
+        """Last globally-committed round (0 before the first full commit
+        wave) — rabit's "last agreed-upon version"."""
+        with self._lock:
+            return self._floor
 
     def alive_ranks(self) -> List[int]:
         """Ranks with a live persistent connection right now."""
@@ -238,6 +329,12 @@ class RabitTracker:
                 if self._shutdown_count >= self.nworker:
                     self._done.set()
             return {"ok": True}
+        if cmd == "commit":
+            # rabit CheckPoint bookkeeping: a worker durably committed a
+            # round-versioned checkpoint; reply with the global floor
+            floor = self.record_commit(int(msg.get("rank", -1)),
+                                       int(msg.get("round", 0)))
+            return {"floor": floor}
         if cmd in ("start", "recover"):
             with self._lock:
                 self._expire_graces_locked()
@@ -256,9 +353,14 @@ class RabitTracker:
                 if rank in self._free_ranks:
                     self._free_ranks.remove(rank)
                 if self._pending_death.pop(rank, None) is not None:
+                    self._arm_grace_timer_locked()
                     _worker_event("reconnect", rank)
+                    if _metrics.enabled():
+                        _tracker_metrics()["deaths"].inc(1,
+                                                         outcome="rejoined")
                     LOG("INFO", "tracker: worker rank %d reconnected within "
                         "the grace window", rank)
+                    self._membership_event_locked("reconnect", rank)
                 for h in [h for h, r in self._host_rank.items() if r == rank]:
                     del self._host_rank[h]
                 if msg.get("host"):
@@ -295,6 +397,9 @@ class RabitTracker:
     def stop(self) -> None:
         self._done.set()
         with self._lock:
+            if self._grace_timer is not None:
+                self._grace_timer.cancel()
+                self._grace_timer = None
             conns = list(self._alive.values())
             self._alive.clear()
         for c in conns:

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Elastic-recovery chaos drill for CI: SIGKILL a worker mid-boost.
+
+Three phases over n=4 local worker PROCESSES coordinated by an
+ElasticTracker (tracker-hub host collectives — the rabit wire role —
+because multiprocess XLA collectives don't exist on the CPU backend):
+
+1. **Baseline** — an uninterrupted 4-worker data-parallel ``fit_external``
+   run over row shards; all four ensembles must agree byte-for-byte.
+2. **Rejoin** — same job, but one worker is SIGKILLed mid-round by the
+   deterministic ``allreduce:kill`` fault.  Survivors abort the in-flight
+   round and roll back to the recovery floor; the parent relaunches the
+   dead rank, which catches up from the floor checkpoint; the finished
+   ensembles must be byte-identical to the baseline (bounded loss = ZERO
+   loss: the deterministic fold makes the replay byte-stable).
+3. **Evict** — elastic mode, short grace: the victim dies at a commit
+   boundary (``worker:kill``) and is NOT replaced.  Once its grace
+   lapses the tracker re-forms the epoch over the 3 survivors,
+   ``shard_row_ranges`` re-cuts the rows, and the job converges with
+   eval loss within 1% of the baseline.
+
+Every process (parent + workers) runs under ``DMLC_LOCKCHECK=1`` and
+verifies zero lock-order cycles.  Recovery metrics
+(``dmlc_worker_deaths_total{outcome}``, ``dmlc_elastic_reshards_total``,
+``dmlc_recovery_floor_round``) are asserted on the tracker registry.
+
+Exit 0 = all phases green.  Usage:
+    python scripts/check_elastic.py            # run the drill
+    python scripts/check_elastic.py --worker   # (internal worker entry)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_WORKERS = 4
+TOTAL_ROUNDS = 12
+STRIDE = 3
+N_ROWS, N_FEAT = 2000, 8
+
+
+def _model_kw():
+    return dict(n_trees=TOTAL_ROUNDS, max_depth=3, n_bins=16,
+                learning_rate=0.3)
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(N_ROWS, N_FEAT)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] - 0.5 * X[:, 3] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# worker entry (subprocess)
+# ---------------------------------------------------------------------------
+
+def worker_main() -> None:
+    from dmlc_core_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.data.iter import ArrayRowIter
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.parallel.recovery import (ElasticSession,
+                                                 ElasticTrainer)
+
+    port = int(os.environ["ELASTIC_TRACKER_PORT"])
+    out_dir = os.environ["ELASTIC_OUT"]
+    rank = int(os.environ.get("ELASTIC_RANK", "-1"))
+    X, y = _dataset()
+
+    sess = ElasticSession("127.0.0.1", port, rank=rank)
+    model = HistGBT(**_model_kw())
+    trainer = ElasticTrainer(model, TOTAL_ROUNDS)  # stride/dir via knobs
+    trainer.run(sess,
+                lambda lo, hi: ArrayRowIter(X[lo:hi], y[lo:hi]),
+                N_ROWS, join_timeout_s=300)
+    model.save_model(os.path.join(out_dir, f"model-rank{sess.grank}.gbt"))
+    with open(os.path.join(out_dir, f"stats-rank{sess.grank}.json"),
+              "w") as f:
+        json.dump({"rounds_replayed": trainer.rounds_replayed,
+                   "resumed_from": trainer.resumed_from}, f)
+    sess.shutdown()
+    lockcheck.check()   # zero lock-order cycles, or die loudly
+
+
+# ---------------------------------------------------------------------------
+# parent: supervise phases
+# ---------------------------------------------------------------------------
+
+def _check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def _launch(port, out_dir, rec_dir, rank=-1, fault=""):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               DMLC_TPU_FORCE_CPU="1",
+               DMLC_LOCKCHECK="1",
+               DMLC_RECOVERY_DIR=rec_dir,
+               DMLC_RECOVERY_STRIDE=str(STRIDE),
+               DMLC_FAULT_INJECT=fault,
+               ELASTIC_TRACKER_PORT=str(port),
+               ELASTIC_OUT=out_dir,
+               ELASTIC_RANK=str(rank))
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"], env=env)
+
+
+def _wait(procs, timeout_s, label):
+    deadline = time.time() + timeout_s
+    for p in procs:
+        left = max(1.0, deadline - time.time())
+        try:
+            p.wait(timeout=left)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            _check(False, f"{label}: worker pid {p.pid} hung")
+
+
+def _read_models(out_dir):
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.startswith("model-rank") and name.endswith(".gbt"):
+            with open(os.path.join(out_dir, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def _loss_of(blob):
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.io.stream import Stream
+    from dmlc_core_tpu.models import HistGBT
+
+    uri = f"mem://elastic/{time.time_ns()}"
+    with Stream.create(uri, "w") as s:
+        s.write(blob)
+    m = HistGBT.load_model(uri)
+    X, y = _dataset()
+    margins = m.predict(X, output_margin=True)
+    return float(m._obj.metric(jnp.asarray(margins), jnp.asarray(y)))
+
+
+def _metric_total(counter, **labels):
+    return sum(s["value"] for s in counter._snap()
+               if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
+def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        worker_main()
+        return
+
+    os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    from dmlc_core_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.base.metrics import default_registry
+    from dmlc_core_tpu.parallel.recovery import ElasticTracker
+
+    reg = default_registry()
+    deaths = reg.counter("worker_deaths_total", labels=("outcome",))
+    reshards = reg.counter("elastic_reshards_total")
+    tmp = tempfile.mkdtemp(prefix="dmlc_elastic")
+
+    # -- phase 1: uninterrupted baseline --------------------------------
+    out1, rec1 = os.path.join(tmp, "out1"), os.path.join(tmp, "rec1")
+    os.makedirs(out1)
+    tracker = ElasticTracker(nworker=N_WORKERS, grace_s=120.0)
+    tracker.start()
+    procs = [_launch(tracker.port, out1, rec1) for _ in range(N_WORKERS)]
+    _wait(procs, 600, "baseline")
+    tracker.stop()
+    _check(all(p.returncode == 0 for p in procs),
+           f"baseline: all {N_WORKERS} workers exited clean "
+           f"({[p.returncode for p in procs]})")
+    models = _read_models(out1)
+    _check(len(models) == N_WORKERS, f"baseline: {N_WORKERS} ensembles")
+    blobs = list(models.values())
+    _check(all(b == blobs[0] for b in blobs),
+           "baseline: ensembles byte-identical across workers")
+    baseline = blobs[0]
+    base_loss = _loss_of(baseline)
+    print(f"   baseline eval loss {base_loss:.5f}")
+
+    # -- phase 2: SIGKILL mid-round, rejoin, byte parity ----------------
+    out2, rec2 = os.path.join(tmp, "out2"), os.path.join(tmp, "rec2")
+    os.makedirs(out2)
+    tracker = ElasticTracker(nworker=N_WORKERS, grace_s=120.0)
+    tracker.start()
+    procs = [_launch(tracker.port, out2, rec2,
+                     fault="allreduce:kill:after=37" if i == 1 else "")
+             for i in range(N_WORKERS)]
+    victim = procs[1]
+    try:
+        victim.wait(timeout=300)
+    except subprocess.TimeoutExpired:
+        _check(False, "rejoin: victim was never killed")
+    _check(victim.returncode == -signal.SIGKILL,
+           f"rejoin: victim SIGKILLed mid-round (rc={victim.returncode})")
+    deadline = time.time() + 60
+    while time.time() < deadline and not tracker.lost_ranks():
+        time.sleep(0.05)
+    lost = tracker.lost_ranks()
+    _check(len(lost) == 1, f"rejoin: tracker holds rank {lost} in grace")
+    replacement = _launch(tracker.port, out2, rec2, rank=lost[0])
+    _wait([p for p in procs if p is not victim] + [replacement],
+          600, "rejoin")
+    tracker.stop()
+    rcs = [p.returncode for p in procs if p is not victim] + [
+        replacement.returncode]
+    _check(all(rc == 0 for rc in rcs),
+           f"rejoin: survivors + rejoiner exited clean ({rcs})")
+    models = _read_models(out2)
+    _check(len(models) == N_WORKERS,
+           f"rejoin: all {N_WORKERS} ranks finished")
+    _check(all(b == baseline for b in models.values()),
+           "rejoin: recovered ensembles byte-identical to baseline")
+    _check(tracker.recovery_floor() == TOTAL_ROUNDS,
+           f"rejoin: recovery floor reached {TOTAL_ROUNDS}")
+    _check(_metric_total(deaths, outcome="rejoined") >= 1,
+           "rejoin: dmlc_worker_deaths_total{outcome=rejoined} counted")
+
+    # -- phase 3: SIGKILL at a commit, evict + elastic re-shard ----------
+    out3, rec3 = os.path.join(tmp, "out3"), os.path.join(tmp, "rec3")
+    os.makedirs(out3)
+    tracker = ElasticTracker(nworker=N_WORKERS, grace_s=1.5, elastic=True)
+    tracker.start()
+    procs = [_launch(tracker.port, out3, rec3,
+                     fault="worker:kill:after=2" if i == 2 else "")
+             for i in range(N_WORKERS)]
+    victim = procs[2]
+    victim.wait(timeout=300)
+    _check(victim.returncode == -signal.SIGKILL,
+           f"evict: victim SIGKILLed at a commit (rc={victim.returncode})")
+    _wait([p for p in procs if p is not victim], 600, "evict")
+    tracker.stop()
+    _check(all(p.returncode == 0 for p in procs if p is not victim),
+           "evict: survivors exited clean")
+    models = _read_models(out3)
+    _check(len(models) == N_WORKERS - 1,
+           f"evict: {N_WORKERS - 1} survivor ensembles")
+    blobs = list(models.values())
+    _check(all(b == blobs[0] for b in blobs),
+           "evict: survivors agree byte-for-byte after the re-shard")
+    evict_loss = _loss_of(blobs[0])
+    rel = abs(evict_loss - base_loss) / max(base_loss, 1e-9)
+    _check(rel < 0.01,
+           f"evict: loss {evict_loss:.5f} within 1% of baseline "
+           f"{base_loss:.5f} (rel {rel:.4f})")
+    _check(_metric_total(reshards) >= 1,
+           "evict: dmlc_elastic_reshards_total counted")
+    _check(_metric_total(deaths, outcome="evicted") >= 1,
+           "evict: dmlc_worker_deaths_total{outcome=evicted} counted")
+
+    lockcheck.check()
+    print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    print("ELASTIC CHAOS DRILL GREEN")
+
+
+if __name__ == "__main__":
+    main()

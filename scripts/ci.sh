@@ -93,6 +93,16 @@ echo "== resilience smoke (kill-and-recover + lossy wire) =="
 # (the doc/robustness.md contract).
 env JAX_PLATFORMS=cpu python scripts/check_resilience.py
 
+echo "== elastic recovery chaos drill (die / rejoin / catch-up + evict) =="
+# n=4 local worker processes co-training over tracker-hub collectives;
+# k=1 is SIGKILLed mid-boost by the deterministic fault injector.  The
+# rejoin path must reproduce the uninterrupted run's save_model bytes
+# exactly (recovery floor + deterministic fold); the elastic-evict path
+# re-shards onto the survivors and must converge within 1% eval loss.
+# Every process runs under DMLC_LOCKCHECK=1 with zero order cycles
+# (doc/robustness.md "Distributed recovery").
+env JAX_PLATFORMS=cpu python scripts/check_elastic.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

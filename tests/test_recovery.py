@@ -1,0 +1,558 @@
+"""Elastic fault-tolerant training: round-versioned commits, recovery
+floor semantics, the tracker consensus (epochs, commit barrier,
+collective hub), die → rejoin → catch-up, and elastic re-shard.
+
+The multi-worker tests run the REAL protocol in-process: one
+ElasticTracker plus one thread per worker, each with its own
+ElasticSession installed as that thread's host-collective transport —
+the same code path the subprocess chaos drill
+(``scripts/check_elastic.py``) exercises with SIGKILL.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dmlc_core_tpu.base import faultinject as fi
+from dmlc_core_tpu.base.metrics import default_registry
+from dmlc_core_tpu.data.iter import ArrayRowIter
+from dmlc_core_tpu.models import HistGBT
+from dmlc_core_tpu.ops.quantile import compute_cuts
+from dmlc_core_tpu.parallel import collectives as coll
+from dmlc_core_tpu.parallel.kvstore import KVStore
+from dmlc_core_tpu.parallel.recovery import (
+    ElasticSession, ElasticTracker, ElasticTrainer,
+    RoundCheckpointer, WorkerAborted, fold_parts, truncate_to_round)
+from dmlc_core_tpu.tracker.tracker import RabitTracker, WorkerSession
+
+
+def _save_bytes(model) -> bytes:
+    path = tempfile.mktemp(suffix=".gbt")
+    try:
+        model.save_model(path)
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def _synth(n, F, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# deterministic fold
+# ---------------------------------------------------------------------------
+
+class TestFoldParts:
+    def test_matches_fixed_pairwise_tree(self):
+        parts = [np.random.default_rng(i).normal(size=7).astype(np.float32)
+                 for i in range(8)]
+        expect = ((parts[0] + parts[1]) + (parts[2] + parts[3])) + (
+            (parts[4] + parts[5]) + (parts[6] + parts[7]))
+        np.testing.assert_array_equal(fold_parts(parts), expect)
+
+    def test_odd_count_carries_tail(self):
+        parts = [np.float32(x) for x in (1, 2, 4)]
+        # ((1+2), 4) -> (3+4): the tail joins one level up, and the
+        # order is fixed — same value every run
+        assert fold_parts(parts) == np.float32(7)
+
+    def test_subtree_composability(self):
+        # a contiguous aligned half folds to the exact subtree value the
+        # full fold uses — what lets a worker pre-fold its own shard
+        parts = [np.random.default_rng(i).normal(size=5).astype(np.float32)
+                 for i in range(4)]
+        full = fold_parts(parts)
+        np.testing.assert_array_equal(
+            fold_parts([fold_parts(parts[:2]), fold_parts(parts[2:])]),
+            full)
+
+
+# ---------------------------------------------------------------------------
+# round-versioned checkpoints
+# ---------------------------------------------------------------------------
+
+class TestRoundCheckpointer:
+    def _model(self, X, y, rounds=3):
+        m = HistGBT(n_trees=rounds, max_depth=3, n_bins=16,
+                    learning_rate=0.3)
+        m.fit(X, y)
+        return m
+
+    def test_commit_restore_roundtrip(self, tmp_path):
+        X, y = _synth(400, 5, seed=2)
+        m = self._model(X, y)
+        ck = RoundCheckpointer(str(tmp_path), rank=0)
+        ck.commit(m, 3, cursor={"rounds": 3})
+        version, loaded, cursor = ck.restore_model(HistGBT, mesh=m.mesh)
+        assert version == 3 and cursor == {"rounds": 3}
+        assert _save_bytes(loaded) == _save_bytes(m)
+
+    def test_cold_start_is_round_zero(self, tmp_path):
+        ck = RoundCheckpointer(str(tmp_path), rank=0)
+        version, blob, cursor = ck.restore()
+        assert version == 0 and blob is None and cursor == {}
+
+    def test_sibling_scan_catches_up_a_diskless_replacement(self, tmp_path):
+        X, y = _synth(400, 5, seed=2)
+        m = self._model(X, y)
+        RoundCheckpointer(str(tmp_path), rank=2).commit(m, 6)
+        # rank 0 never wrote a file but the floor says 6: adopt rank 2's
+        ck0 = RoundCheckpointer(str(tmp_path), rank=0)
+        version, blob, _ = ck0.restore(floor=6)
+        assert version == 6 and blob is not None
+
+    def test_truncate_to_round_rolls_back_and_clears_margins(self):
+        X, y = _synth(400, 5, seed=2)
+        m = self._model(X, y, rounds=4)
+        assert m._train_preds is not None
+        truncate_to_round(m, 2)
+        assert len(m.trees) == 2 and m._train_preds is None
+
+
+# ---------------------------------------------------------------------------
+# tracker: deadline-driven grace expiry (regression) + floor tracking
+# ---------------------------------------------------------------------------
+
+class TestTrackerGraceDeadline:
+    def test_silent_cluster_expires_grace_without_traffic(self):
+        """Lazy expiry only ran on message arrival: with zero tracker
+        traffic a lapsed deadline went unnoticed.  The deadline timer
+        must flush it — observable on ``dead_workers`` directly, no
+        ``lost_ranks()`` poke allowed."""
+        tracker = RabitTracker(nworker=1, grace_s=0.3)
+        tracker.start()
+        try:
+            ws = WorkerSession("127.0.0.1", tracker.port, host="h0")
+            rank = ws.info["rank"]
+            ws.close()  # no shutdown: abnormal death
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not tracker.dead_workers:
+                time.sleep(0.05)  # NO tracker messages in this window
+            assert tracker.dead_workers == [rank]
+            with tracker._lock:
+                assert not tracker._pending_death
+        finally:
+            tracker.stop()
+
+    def test_reconnect_cancels_pending_expiry(self):
+        tracker = RabitTracker(nworker=1, grace_s=30.0)
+        tracker.start()
+        try:
+            ws = WorkerSession("127.0.0.1", tracker.port, host="h0")
+            rank = ws.info["rank"]
+            ws.close()
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not tracker.lost_ranks():
+                time.sleep(0.02)
+            back = WorkerSession("127.0.0.1", tracker.port, cmd="recover",
+                                 rank=rank)
+            assert back.info["rank"] == rank
+            assert tracker.lost_ranks() == []
+            assert tracker.dead_workers == []
+            back.shutdown()
+        finally:
+            tracker.stop()
+
+    def test_commit_cmd_tracks_floor(self):
+        tracker = RabitTracker(nworker=2, grace_s=0.0)
+        # floor = min over expected ranks; one rank committing alone
+        # cannot advance it
+        assert tracker.record_commit(0, 5) == 0
+        assert tracker.record_commit(1, 3) == 3
+        assert tracker.record_commit(1, 5) == 5
+        assert tracker.recovery_floor() == 5
+        # the commit command reports the same floor over the wire
+        reply = tracker._handle({"cmd": "commit", "rank": 0, "round": 7})
+        assert reply == {"floor": 5}
+
+
+# ---------------------------------------------------------------------------
+# collectives transport hook
+# ---------------------------------------------------------------------------
+
+class _FakeTransport:
+    rank = 3
+    world = 7
+
+    def allreduce(self, x, op="sum"):
+        return x * 10
+
+    def allgather(self, x):
+        return np.stack([x, x])
+
+    def broadcast(self, v, root=0):
+        return ("bcast", v, root)
+
+    def barrier(self, name="dmlc"):
+        self.barriered = name
+
+
+class TestHostTransportHook:
+    def test_thread_local_override_and_clear(self):
+        t = _FakeTransport()
+        coll.set_host_transport(t)
+        try:
+            assert coll.rank() == 3 and coll.world_size() == 7
+            assert coll.is_distributed()
+            np.testing.assert_array_equal(
+                coll.allreduce(np.ones(3)), np.ones(3) * 10)
+            assert coll.allgather(np.ones(2)).shape == (2, 2)
+            assert coll.broadcast("x", root=2) == ("bcast", "x", 2)
+            coll.barrier("sync")
+            assert t.barriered == "sync"
+            out = coll.allreduce_device(jnp.ones(4))
+            np.testing.assert_array_equal(np.asarray(out), np.ones(4) * 10)
+        finally:
+            coll.set_host_transport(None)
+        assert coll.rank() == 0 and coll.world_size() == 1
+
+    def test_other_threads_unaffected(self):
+        coll.set_host_transport(_FakeTransport())
+        seen = {}
+        try:
+            th = threading.Thread(
+                target=lambda: seen.update(w=coll.world_size()))
+            th.start()
+            th.join()
+        finally:
+            coll.set_host_transport(None)
+        assert seen["w"] == 1
+
+
+# ---------------------------------------------------------------------------
+# single-worker crash-safe loop: checkpoint-floor property
+# ---------------------------------------------------------------------------
+
+class TestCheckpointFloorProperty:
+    @pytest.mark.parametrize("stride,after", [(2, 2), (3, 4), (3, 7)])
+    def test_kill_at_round_r_resumes_from_floor(self, tmp_path, monkeypatch,
+                                                stride, after):
+        """For a kill at round r and commit stride K, recovery resumes
+        from floor(r/K)·K and the finished ensemble's save_model bytes
+        equal the uninterrupted run's (deterministic fold)."""
+        monkeypatch.setenv("DMLC_HIST_BLOCKS", "8")
+        monkeypatch.setenv("DMLC_TPU_ROUNDS_PER_DISPATCH", "1")
+        X, y = _synth(601, 6, seed=3)
+        cuts = compute_cuts(X, 16)
+        total = 8
+        kw = dict(n_trees=total, max_depth=3, n_bins=16, learning_rate=0.3)
+
+        base = HistGBT(**kw)
+        base.fit(X, y, cuts=cuts)
+        base_bytes = _save_bytes(base)
+
+        d = str(tmp_path)
+        m1 = HistGBT(**kw)
+        tr1 = ElasticTrainer(m1, total, recovery_dir=d, stride=stride)
+        dd1 = m1.make_device_data(X, y, cuts=cuts)
+        with fi.inject(f"worker:abort:after={after}"):
+            with pytest.raises(WorkerAborted):
+                tr1.run_device(dd1)
+        r = tr1.rounds_trained
+        assert r >= 1
+
+        m2 = HistGBT(**kw)
+        tr2 = ElasticTrainer(m2, total, recovery_dir=d, stride=stride)
+        dd2 = m2.make_device_data(X, y, cuts=cuts)
+        tr2.run_device(dd2)
+        expected_floor = (r // stride) * stride
+        assert (tr2.resumed_from or 0) == expected_floor
+        assert _save_bytes(m2) == base_bytes
+
+    def test_clean_run_commits_and_is_bit_identical(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("DMLC_HIST_BLOCKS", "8")
+        X, y = _synth(601, 6, seed=3)
+        cuts = compute_cuts(X, 16)
+        kw = dict(n_trees=6, max_depth=3, n_bins=16, learning_rate=0.3)
+        base = HistGBT(**kw)
+        base.fit(X, y, cuts=cuts)
+        m = HistGBT(**kw)
+        tr = ElasticTrainer(m, 6, recovery_dir=str(tmp_path), stride=2)
+        tr.run_device(m.make_device_data(X, y, cuts=cuts))
+        assert _save_bytes(m) == _save_bytes(base)
+        version, blob, cursor = RoundCheckpointer(str(tmp_path)).restore()
+        assert version == 6 and blob is not None
+
+
+# ---------------------------------------------------------------------------
+# resumable engines
+# ---------------------------------------------------------------------------
+
+class TestEngineResume:
+    def test_fit_device_resume_carried_vs_replayed_bits(self):
+        X, y = _synth(601, 6, seed=4)
+        cuts = compute_cuts(X, 16)
+        kw = dict(n_trees=6, max_depth=3, n_bins=16, learning_rate=0.3)
+        base = HistGBT(**kw)
+        base.fit(X, y, cuts=cuts)
+        for clear_carry in (False, True):
+            m = HistGBT(**kw)
+            dd = m.make_device_data(X, y, cuts=cuts)
+            done = 0
+            while done < 6:
+                k = min(2, 6 - done)
+                m.param.n_trees = k
+                if clear_carry:
+                    m._train_preds = None  # force the replay route
+                m.fit_device(dd, resume=done > 0)
+                done += k
+            for t_base, t_m in zip(base.trees, m.trees):
+                for key in t_base:
+                    np.testing.assert_array_equal(t_base[key], t_m[key])
+
+    def test_fit_external_continues_from_trees(self):
+        X, y = _synth(900, 5, seed=5)
+        kw = dict(n_trees=6, max_depth=3, n_bins=16, learning_rate=0.3,
+                  hist_method="segment")
+        base = HistGBT(**kw)
+        base.fit_external(ArrayRowIter(X, y))
+        cuts = base.cuts
+        m = HistGBT(**kw)
+        m.param.n_trees = 2
+        m.fit_external(ArrayRowIter(X, y), cuts=cuts)
+        assert len(m.trees) == 2
+        m.param.n_trees = 4
+        m.fit_external(ArrayRowIter(X, y), cuts=cuts)
+        assert len(m.trees) == 6
+        for t_base, t_m in zip(base.trees, m.trees):
+            np.testing.assert_array_equal(t_base["feat"], t_m["feat"])
+            np.testing.assert_array_equal(t_base["thr"], t_m["thr"])
+            np.testing.assert_allclose(t_base["leaf"], t_m["leaf"],
+                                       rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# distributed protocol (in-process workers: one thread per rank)
+# ---------------------------------------------------------------------------
+
+N_ROWS, N_FEAT, TOTAL, STRIDE = 1501, 6, 6, 2
+_KW = dict(n_trees=TOTAL, max_depth=3, n_bins=16, learning_rate=0.3)
+_DATA = _synth(N_ROWS, N_FEAT, seed=1)
+
+
+def _make_worker(tracker, directory, out, errs, rank=-1,
+                 die_after_faults=None):
+    X, y = _DATA
+
+    def worker():
+        sess = None
+        try:
+            sess = ElasticSession("127.0.0.1", tracker.port, rank=rank)
+            m = HistGBT(**_KW)
+            tr = ElasticTrainer(m, TOTAL, recovery_dir=directory,
+                                stride=STRIDE)
+            if die_after_faults is not None:
+                calls = [0]
+
+                def fault():
+                    calls[0] += 1
+                    if calls[0] > die_after_faults:
+                        raise WorkerAborted("simulated death")
+                tr._worker_fault = fault
+            tr.run(sess,
+                   lambda lo, hi: ArrayRowIter(X[lo:hi], y[lo:hi]),
+                   N_ROWS, join_timeout_s=90)
+            out[sess.grank] = (_save_bytes(m), tr.rounds_replayed, m)
+            sess.shutdown()
+        except WorkerAborted:
+            sess.close()  # socket closes WITHOUT shutdown == death
+        except Exception as e:  # noqa: BLE001 — surfaced by the test
+            errs.append(repr(e))
+    return threading.Thread(target=worker, daemon=True)
+
+
+def _run_clean(directory, nworker=3):
+    tracker = ElasticTracker(nworker=nworker, grace_s=30.0)
+    tracker.start()
+    out, errs = {}, []
+    try:
+        threads = [_make_worker(tracker, directory, out, errs)
+                   for _ in range(nworker)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+    finally:
+        tracker.stop()
+    assert not errs, errs
+    assert sorted(out) == list(range(nworker))
+    return out
+
+
+@pytest.fixture(scope="module")
+def clean_blob():
+    """One uninterrupted 3-worker run — the byte oracle every chaos
+    variant must reproduce."""
+    with tempfile.TemporaryDirectory(prefix="dmlc_rec") as d:
+        out = _run_clean(d)
+        blobs = [v[0] for v in out.values()]
+        assert all(b == blobs[0] for b in blobs), \
+            "workers disagree on the clean ensemble"
+        yield blobs[0]
+
+
+class TestElasticProtocol:
+    def test_clean_run_trains_and_agrees(self, clean_blob):
+        assert len(clean_blob) > 0
+
+    def test_injected_allreduce_abort_replays_bit_identical(self,
+                                                            clean_blob):
+        with tempfile.TemporaryDirectory(prefix="dmlc_rec") as d:
+            with fi.inject("allreduce:abort:after=25:n=1"):
+                out = _run_clean(d)
+            assert fi.fired_total() == 0  # scoped injector restored
+            for blob, replayed, _m in out.values():
+                assert blob == clean_blob
+            assert any(v[1] > 0 for v in out.values()), \
+                "abort fired but nobody replayed rounds"
+
+    def test_die_and_rejoin_is_bit_identical(self, clean_blob):
+        with tempfile.TemporaryDirectory(prefix="dmlc_rec") as d:
+            tracker = ElasticTracker(nworker=3, grace_s=60.0)
+            tracker.start()
+            out, errs = {}, []
+            try:
+                threads = [
+                    _make_worker(tracker, d, out, errs,
+                                 die_after_faults=1 if i == 1 else None)
+                    for i in range(3)]
+                for t in threads:
+                    t.start()
+                deadline = time.time() + 60
+                while time.time() < deadline and not tracker.lost_ranks():
+                    time.sleep(0.05)
+                lost = tracker.lost_ranks()
+                assert len(lost) == 1
+                rejoin = _make_worker(tracker, d, out, errs, rank=lost[0])
+                rejoin.start()
+                for t in threads:
+                    t.join(timeout=240)
+                rejoin.join(timeout=240)
+            finally:
+                tracker.stop()
+            assert not errs, errs
+            assert sorted(out) == [0, 1, 2]
+            for blob, _replayed, _m in out.values():
+                assert blob == clean_blob
+            # the rejoiner caught up from the floor checkpoint;
+            # survivors replayed their aborted leg
+            assert tracker.recovery_floor() == TOTAL
+
+    def test_evict_reshards_over_survivors(self):
+        X, y = _DATA
+        reshards = default_registry().counter("elastic_reshards_total")
+        before = sum(s["value"] for s in reshards._snap())
+        with tempfile.TemporaryDirectory(prefix="dmlc_rec") as d:
+            tracker = ElasticTracker(nworker=3, grace_s=0.6, elastic=True)
+            tracker.start()
+            out, errs = {}, []
+            try:
+                threads = [
+                    _make_worker(tracker, d, out, errs,
+                                 die_after_faults=1 if i == 2 else None)
+                    for i in range(3)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=240)
+            finally:
+                tracker.stop()
+            assert not errs, errs
+            assert len(out) == 2 and len(tracker.dead_workers) == 1
+            blobs = [v[0] for v in out.values()]
+            assert blobs[0] == blobs[1], \
+                "survivors disagree after the re-shard"
+            model = next(iter(out.values()))[2]
+            assert len(model.trees) == TOTAL
+            # converged: eval loss within a few percent of a plain fit
+            base = HistGBT(**_KW)
+            base.fit(X, y)
+            def loss(m):
+                p = m.predict(X, output_margin=True)
+                return float(m._obj.metric(jnp.asarray(p), jnp.asarray(y)))
+            lb, le = loss(base), loss(model)
+            assert abs(le - lb) / lb < 0.05, (lb, le)
+        after = sum(s["value"] for s in reshards._snap())
+        assert after == before + 1
+
+    def test_late_joiner_after_shrink_is_evicted(self):
+        from dmlc_core_tpu.parallel.recovery import EvictedError
+        tracker = ElasticTracker(nworker=2, grace_s=0.2, elastic=True)
+        tracker.start()
+        try:
+            s0 = ElasticSession("127.0.0.1", tracker.port)
+            s1 = ElasticSession("127.0.0.1", tracker.port)
+            r0 = {}
+            t0 = threading.Thread(target=lambda: r0.update(s0.join()))
+            t0.start()
+            s1.join(timeout_s=30)
+            t0.join(timeout=30)
+            assert r0["world"] == 2
+            # rank 1 dies; grace lapses; rank 0 re-forms alone (in the
+            # trainer flow a survivor re-joins only after its abort —
+            # mirror that by waiting for the tracker to see the death)
+            dead_rank = s1.grank
+            s1.close()
+            deadline = time.time() + 10
+            while time.time() < deadline and not tracker.dead_workers:
+                time.sleep(0.05)
+            assert tracker.dead_workers == [dead_rank]
+            info = s0.join(timeout_s=30)
+            assert info["world"] == 1
+            # the dead rank's replacement knocks after the shrink
+            s2 = ElasticSession("127.0.0.1", tracker.port, rank=dead_rank)
+            with pytest.raises(EvictedError):
+                s2.join(timeout_s=5)
+            s2.close()
+            s0.close()
+        finally:
+            tracker.stop()
+
+
+# ---------------------------------------------------------------------------
+# KVStore bounded-staleness recovery
+# ---------------------------------------------------------------------------
+
+class TestKVStoreRecovery:
+    def test_snapshot_every_stride_and_restore(self, tmp_path):
+        uri = str(tmp_path / "kv.ckpt")
+        kv = KVStore.create("local", learning_rate=0.5)
+        kv.init(["w", "b"], [np.ones(4, np.float32),
+                             np.zeros(2, np.float32)])
+        kv.enable_recovery(uri, stride=2)
+        snap_at_4 = None
+        for step in range(5):
+            kv.push(["w", "b"], [np.full(4, 0.1, np.float32),
+                                 np.full(2, 0.2, np.float32)])
+            kv.pull(["w", "b"])
+            if step == 3:
+                snap_at_4 = [np.asarray(kv.pull("w")),
+                             np.asarray(kv.pull("b"))]
+        # 5 pulls, stride 2 → newest snapshot is pull-round 4
+        kv2 = KVStore.create("local", learning_rate=0.5)
+        kv2.init(["w", "b"], [np.ones(4, np.float32),
+                              np.zeros(2, np.float32)])
+        version = kv2.restore_recovery(uri)
+        assert version == 4
+        np.testing.assert_array_equal(np.asarray(kv2.pull("w")),
+                                      snap_at_4[0])
+        np.testing.assert_array_equal(np.asarray(kv2.pull("b")),
+                                      snap_at_4[1])
+
+    def test_restore_without_snapshot_is_version_zero(self, tmp_path):
+        kv = KVStore.create("local")
+        kv.init("w", np.ones(3, np.float32))
+        assert kv.restore_recovery(str(tmp_path / "none.ckpt")) == 0
