@@ -221,6 +221,38 @@ declare("DMLC_SERVE_PREWARM", "0",
         "1 pre-compiles the batch-bucket ladder at ModelRunner "
         "construction (serve cold-start).", "serve")
 
+# -- fleet serving ----------------------------------------------------------
+declare("DMLC_FLEET_VNODES", 64,
+        "Virtual nodes per replica on the router's consistent-hash ring "
+        "(more = smoother balance, larger ring).", "fleet")
+declare("DMLC_FLEET_MAX_QUEUE", 512,
+        "Fleet-wide queued-request bound for router admission control; "
+        "beyond it predicts are shed with 503 + Retry-After.", "fleet")
+declare("DMLC_FLEET_PROBE_S", 0.5,
+        "Router health-probe / membership-refresh interval in "
+        "seconds.", "fleet")
+declare("DMLC_FLEET_FAILOVER", 2,
+        "Extra replicas the router tries after the hash-primary fails "
+        "(total attempts = 1 + this).", "fleet")
+declare("DMLC_FLEET_HEARTBEAT_S", 0.5,
+        "Replica load-report (serve_report) interval in "
+        "seconds.", "fleet")
+declare("DMLC_FLEET_WAVE_SIZE", 1,
+        "Replicas activated per staged-rollout wave.", "fleet")
+declare("DMLC_FLEET_SCALE_OUT_S", 0.05,
+        "Queue-wait p99 seconds above which the autoscale policy "
+        "recommends scale-out.", "fleet")
+declare("DMLC_FLEET_SCALE_IN_S", 0.005,
+        "Queue-wait p99 seconds below which the autoscale policy "
+        "recommends scale-in.", "fleet")
+declare("DMLC_FLEET_PATIENCE", 3,
+        "Consecutive out-of-band autoscale observations required before "
+        "a recommendation fires (hysteresis).", "fleet")
+declare("DMLC_FLEET_MIN_REPLICAS", 1,
+        "Autoscale floor on replica count.", "fleet")
+declare("DMLC_FLEET_MAX_REPLICAS", 8,
+        "Autoscale ceiling on replica count.", "fleet")
+
 # -- streaming / online learning --------------------------------------------
 declare("DMLC_STREAM_POLL_S", 0.05,
         "Tailer base poll interval in seconds; idle polls back off "

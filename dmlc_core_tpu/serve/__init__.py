@@ -35,7 +35,8 @@ Quick start (see ``examples/serve_gbt.py`` and ``doc/serving.md``)::
 from dmlc_core_tpu.serve.batcher import (BatcherClosedError,  # noqa: F401
                                          DynamicBatcher, QueueFullError)
 from dmlc_core_tpu.serve.client import ResilientClient  # noqa: F401
-from dmlc_core_tpu.serve.frontend import ServeFrontend  # noqa: F401
+from dmlc_core_tpu.serve.frontend import (HttpServer,  # noqa: F401
+                                          ServeFrontend)
 from dmlc_core_tpu.serve.instruments import serve_metrics  # noqa: F401
 from dmlc_core_tpu.serve.registry import (ModelRegistry,  # noqa: F401
                                           checkpoint_model, clone_model,
@@ -45,6 +46,6 @@ from dmlc_core_tpu.serve.runner import ModelRunner  # noqa: F401
 __all__ = [
     "ModelRunner", "DynamicBatcher", "QueueFullError",
     "BatcherClosedError", "ModelRegistry", "checkpoint_model",
-    "clone_model", "load_model_checkpoint", "ServeFrontend",
-    "ResilientClient", "serve_metrics",
+    "clone_model", "load_model_checkpoint", "HttpServer",
+    "ServeFrontend", "ResilientClient", "serve_metrics",
 ]

@@ -1,0 +1,208 @@
+"""Closed-loop multi-process load generator for the fleet.
+
+Traffic shape is deliberately hostile in the two ways real serving
+traffic is:
+
+* **heavy-tail request sizes** — rows per predict follow a capped
+  Pareto (:func:`sample_size`): most requests are small, a fat tail
+  lands near ``max_size``, so batch assembly sees the mix that makes
+  power-of-two bucketing earn its keep;
+* **diurnal QPS ramp** — the target rate follows a sinusoid
+  (:func:`diurnal_qps`), so a run sweeps through under- and over-load
+  instead of testing one operating point.
+
+Each worker **process** (``python -m dmlc_core_tpu.serve.fleet.loadgen
+--worker cfg.json`` — a real process, so client-side CPU cannot be
+the hidden bottleneck of the thing it measures) runs closed-loop
+threads: issue one predict through
+:class:`~dmlc_core_tpu.serve.client.ResilientClient` (failover +
+Retry-After honored), wait for the answer, verify it **bit-exactly**
+against the expected predictions for whatever version answered, then
+pace to the ramp.  Every request therefore ends in exactly one bucket:
+
+* ``ok``     — answered, and bit-identical to ``expected[version]``;
+* ``wrong``  — answered with anything else (the unforgivable bucket);
+* ``dropped``— no answer after the client's whole retry budget.
+
+``run_loadgen`` fans out the workers, merges their reports, and
+returns fleet p50/p95/p99, per-version counts, and the drop/wrong
+totals that the hot-swap acceptance gate (``dropped==0 and wrong==0``)
+reads.  The expected predictions ride an ``.npz``: array ``X`` plus
+one array ``v{version}`` per version the fleet may answer with.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from dmlc_core_tpu.base.logging import CHECK
+
+__all__ = ["sample_size", "diurnal_qps", "run_loadgen", "loadgen_worker"]
+
+
+def sample_size(rng: np.random.Generator, alpha: float = 1.5,
+                max_size: int = 32) -> int:
+    """Heavy-tailed rows-per-request: capped Pareto(``alpha``) ≥ 1.
+    Small ``alpha`` = fatter tail."""
+    return int(min(max_size, max(1, math.floor(1.0 + rng.pareto(alpha)))))
+
+
+def diurnal_qps(t_s: float, base_qps: float, amplitude: float = 0.5,
+                period_s: float = 10.0) -> float:
+    """Target rate at ``t_s`` seconds into the run: a sinusoidal
+    day/night ramp around ``base_qps`` (peak = base×(1+amplitude)),
+    floored at 10% of base so the loop never stalls.  Pure."""
+    qps = base_qps * (1.0 + amplitude * math.sin(2.0 * math.pi * t_s
+                                                / period_s))
+    return max(0.1 * base_qps, qps)
+
+
+def _client_thread(cfg: Dict[str, Any], X: np.ndarray,
+                   expected: Dict[int, np.ndarray], seed: int,
+                   out: List[Any]) -> None:
+    from dmlc_core_tpu.serve.client import ResilientClient
+
+    client = ResilientClient(cfg["endpoints"])
+    rng = np.random.default_rng(seed)
+    per_thread_qps = cfg["base_qps"] / (cfg["procs"] * cfg["threads"])
+    t_start = time.monotonic()
+    next_t = t_start
+    while True:
+        now = time.monotonic()
+        if now - t_start >= cfg["duration_s"]:
+            return
+        k = sample_size(rng, cfg["alpha"], cfg["max_size"])
+        lo = int(rng.integers(0, len(X) - k + 1))
+        t0 = time.monotonic()
+        try:
+            preds, version = client.predict(
+                X[lo:lo + k], timeout_ms=cfg["timeout_ms"])
+            lat = time.monotonic() - t0
+            want = expected.get(int(version))
+            if want is not None and np.array_equal(
+                    preds, want[lo:lo + k]):
+                out.append(("ok", int(version), lat))
+            else:
+                out.append(("wrong", int(version), lat))
+        except Exception:  # noqa: BLE001 — retry budget exhausted
+            out.append(("dropped", -1, time.monotonic() - t0))
+        # closed-loop pacing against the diurnal ramp: never issue
+        # before the previous answer, sleep off any surplus
+        rate = diurnal_qps(now - t_start, per_thread_qps,
+                           cfg["amplitude"], cfg["period_s"])
+        next_t = max(next_t + 1.0 / rate, time.monotonic())
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+
+def loadgen_worker(cfg_path: str) -> int:
+    """Worker-process entry: run the configured closed-loop threads and
+    write the per-process report JSON."""
+    import threading
+
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    data = np.load(cfg["expected_npz"])
+    X = np.asarray(data["X"], np.float32)
+    expected = {int(k[1:]): np.asarray(data[k], np.float32)
+                for k in data.files if k.startswith("v")}
+    out: List[Any] = []
+    threads = [threading.Thread(
+        target=_client_thread,
+        args=(cfg, X, expected, cfg["seed"] * 1000 + t, out))
+        for t in range(cfg["threads"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=cfg["duration_s"] + 60)
+    report = {
+        "count": len(out),
+        "ok": sum(1 for s, _, _ in out if s == "ok"),
+        "dropped": sum(1 for s, _, _ in out if s == "dropped"),
+        "wrong": sum(1 for s, _, _ in out if s == "wrong"),
+        "by_version": {},
+        "lats_ms": [round(lat * 1000.0, 3) for s, _, lat in out
+                    if s == "ok"],
+    }
+    for s, v, _ in out:
+        if s == "ok":
+            key = str(v)
+            report["by_version"][key] = report["by_version"].get(key, 0) + 1
+    with open(cfg["out"], "w") as f:
+        json.dump(report, f)
+    return 0
+
+
+def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
+                duration_s: float = 5.0, procs: int = 2, threads: int = 4,
+                base_qps: float = 200.0, amplitude: float = 0.5,
+                period_s: float = 10.0, alpha: float = 1.5,
+                max_size: int = 32, timeout_ms: int = 2000,
+                seed: int = 0, workdir: Optional[str] = None,
+                env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Fan out ``procs`` worker processes against ``endpoints`` (one
+    router URL or a replica URL list) and merge their reports into the
+    fleet summary: ``{count, ok, dropped, wrong, by_version,
+    latency_p50/95/99_ms, throughput_rps}``."""
+    CHECK(procs >= 1 and threads >= 1,
+          f"need >=1 procs/threads, got {procs}/{threads}")
+    import tempfile
+
+    eps = [endpoints] if isinstance(endpoints, str) else list(endpoints)
+    workdir = workdir or tempfile.mkdtemp(prefix="fleet_loadgen_")
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env.update(env or {})
+    children = []
+    t0 = time.monotonic()
+    for p in range(procs):
+        cfg = {"endpoints": eps, "expected_npz": expected_npz,
+               "duration_s": duration_s, "procs": procs,
+               "threads": threads, "base_qps": base_qps,
+               "amplitude": amplitude, "period_s": period_s,
+               "alpha": alpha, "max_size": max_size,
+               "timeout_ms": timeout_ms, "seed": seed + p,
+               "out": os.path.join(workdir, f"loadgen_{p}.json")}
+        cfg_path = os.path.join(workdir, f"loadgen_{p}.cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        children.append((cfg, subprocess.Popen(
+            [sys.executable, "-m", "dmlc_core_tpu.serve.fleet.loadgen",
+             "--worker", cfg_path], env=child_env)))
+    merged: Dict[str, Any] = {"count": 0, "ok": 0, "dropped": 0,
+                              "wrong": 0, "by_version": {}}
+    lats: List[float] = []
+    for cfg, proc in children:
+        rc = proc.wait(timeout=duration_s + 120)
+        CHECK(rc == 0, f"loadgen worker exited rc={rc}")
+        with open(cfg["out"]) as f:
+            rep = json.load(f)
+        for k in ("count", "ok", "dropped", "wrong"):
+            merged[k] += rep[k]
+        for v, n in rep["by_version"].items():
+            merged["by_version"][v] = merged["by_version"].get(v, 0) + n
+        lats.extend(rep["lats_ms"])
+    wall = time.monotonic() - t0
+    merged["wall_s"] = round(wall, 3)
+    merged["throughput_rps"] = round(merged["ok"] / max(wall, 1e-9), 2)
+    for q, key in ((50, "latency_p50_ms"), (95, "latency_p95_ms"),
+                   (99, "latency_p99_ms")):
+        merged[key] = (round(float(np.percentile(lats, q)), 3)
+                       if lats else None)
+    return merged
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--worker":
+        sys.exit(loadgen_worker(sys.argv[2]))
+    print("usage: python -m dmlc_core_tpu.serve.fleet.loadgen "
+          "--worker cfg.json", file=sys.stderr)
+    sys.exit(2)

@@ -1,0 +1,328 @@
+"""Fleet router: consistent hashing, health probes, failover, admission.
+
+One HTTP tier in front of N replicas, built from pieces the repo
+already trusts: the :class:`~dmlc_core_tpu.serve.frontend.HttpServer`
+request loop, per-replica
+:class:`~dmlc_core_tpu.base.resilience.CircuitBreaker` state, and the
+:class:`~dmlc_core_tpu.serve.fleet.replica.FleetTracker` membership
+view.
+
+**Routing** is consistent hashing over the request body
+(:class:`HashRing`, MD5, ``DMLC_FLEET_VNODES`` virtual nodes per
+replica): identical predict payloads land on the same replica while it
+is healthy — cache/XLA-bucket affinity — and a membership change moves
+only ~1/N of the keyspace (pinned by ``tests/test_fleet.py``).
+
+**Failover**: predict is idempotent (a pure function of the rows), so
+a failed attempt walks the ring to the next distinct replica, up to
+``DMLC_FLEET_FAILOVER`` extra tries.  The breaker discipline is
+deliberate: a transport error or 5xx records a failure (enough of them
+open the circuit and the replica is skipped instantly until its
+half-open probe); a **503 shed records a success** — the replica is
+alive and protecting itself, and opening its circuit for doing so
+would amplify overload into blackout.
+
+**Admission control**: when the fleet-wide queued-request count (sum
+of healthy replicas' probed queue depth) exceeds
+``DMLC_FLEET_MAX_QUEUE``, the router sheds with 503 + ``Retry-After``
+*before* burning a replica round trip — the fleet-level analogue of
+the batcher's full-queue 503.
+
+The response body of a routed predict is passed through **verbatim** —
+the router adds zero serialization steps, so fleet predictions stay
+bit-identical to single-replica ones.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.resilience import CircuitBreaker, RetryPolicy
+from dmlc_core_tpu.io.http_util import HttpError, http_request
+from dmlc_core_tpu.serve.fleet.instruments import fleet_metrics
+from dmlc_core_tpu.serve.fleet.replica import FleetTracker
+from dmlc_core_tpu.serve.frontend import HttpServer
+
+__all__ = ["HashRing", "FleetRouter"]
+
+#: one physical attempt per candidate replica — the router's ring walk
+#: IS the retry loop, an inner retry would multiply tail latency
+_ONE_ATTEMPT = RetryPolicy(max_attempts=1)
+
+
+class HashRing:
+    """Consistent-hash ring over an immutable node set.
+
+    Pure and deterministic (MD5 of ``"{node}#{vnode}"``), so every
+    router process — and the stability test — derives the identical
+    ring from the same membership.  Build a NEW ring on membership
+    change; lookups are lock-free reads of immutable state.
+    """
+
+    def __init__(self, nodes: Sequence[Any], vnodes: Optional[int] = None):
+        if vnodes is None:
+            vnodes = int(os.environ.get("DMLC_FLEET_VNODES", "64"))
+        CHECK(vnodes >= 1, f"vnodes must be >= 1, got {vnodes}")
+        self.nodes = sorted(set(nodes))
+        points: List[Tuple[int, Any]] = []
+        for node in self.nodes:
+            for i in range(vnodes):
+                points.append((self._hash(f"{node}#{i}".encode()), node))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [n for _, n in points]
+
+    @staticmethod
+    def _hash(data: bytes) -> int:
+        return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
+    def lookup(self, key: bytes) -> Any:
+        """Owning node for ``key`` (first vnode clockwise)."""
+        CHECK(self.nodes, "lookup on an empty HashRing")
+        return self._owners[self._index(key)]
+
+    def sequence(self, key: bytes) -> List[Any]:
+        """All nodes in preference order for ``key``: the owner, then
+        each DISTINCT next node clockwise — the failover walk."""
+        if not self.nodes:
+            return []
+        out: List[Any] = []
+        i = self._index(key)
+        for k in range(len(self._owners)):
+            node = self._owners[(i + k) % len(self._owners)]
+            if node not in out:
+                out.append(node)
+                if len(out) == len(self.nodes):
+                    break
+        return out
+
+    def _index(self, key: bytes) -> int:
+        h = self._hash(key)
+        i = bisect.bisect_right(self._hashes, h)
+        return i % len(self._hashes)
+
+
+class _ReplicaState:
+    """Router-side view of one replica (mutable fields guarded by the
+    router's lock; the breaker is internally thread-safe)."""
+
+    def __init__(self, rank: int, url: str):
+        self.rank = rank
+        self.url = url
+        self.breaker = CircuitBreaker.from_env(name=f"fleet:replica{rank}")
+        self.healthy = False
+        self.queue_depth = 0
+        self.version: Optional[int] = None
+        self.status = "unknown"
+
+    def doc(self) -> Dict[str, Any]:
+        return {"url": self.url, "healthy": self.healthy,
+                "status": self.status, "queue_depth": self.queue_depth,
+                "version": self.version, "breaker": self.breaker.state}
+
+
+class FleetRouter(HttpServer):
+    """HTTP router/load-balancer over a :class:`FleetTracker`'s fleet.
+
+    A background thread refreshes membership from the tracker and
+    health-probes every replica (``GET /healthz``) each
+    ``DMLC_FLEET_PROBE_S``; the ring only contains replicas whose last
+    probe answered ``status: ok``.  ``/predict`` routes by body hash
+    with breaker-guarded failover; ``/healthz`` answers the router's
+    own fleet view; ``/metrics`` exposes the process registry.
+    """
+
+    def __init__(self, tracker: FleetTracker, host: str = "127.0.0.1",
+                 port: int = 0, max_queue: Optional[int] = None,
+                 probe_s: Optional[float] = None,
+                 failover: Optional[int] = None):
+        super().__init__(host=host, port=port, name="fleet-router")
+        self._tracker = tracker
+        self.max_queue = (max_queue if max_queue is not None else
+                          int(os.environ.get("DMLC_FLEET_MAX_QUEUE", "512")))
+        self.probe_s = (probe_s if probe_s is not None else
+                        float(os.environ.get("DMLC_FLEET_PROBE_S", "0.5")))
+        self.failover = (failover if failover is not None else
+                         int(os.environ.get("DMLC_FLEET_FAILOVER", "2")))
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, _ReplicaState] = {}
+        self._ring = HashRing([])
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="fleet-probe")
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        """Probe once (so the first request already has a fleet view),
+        then begin accepting and probing."""
+        self.probe_now()
+        super().start()
+        self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        super().close()          # sets _done → probe loop exits
+        if self._probe_thread.is_alive():
+            self._probe_thread.join(timeout=2.0)
+
+    # -- membership / health ---------------------------------------------
+    def _probe_loop(self) -> None:
+        while not self._done.wait(self.probe_s):
+            try:
+                self.probe_now()
+            except Exception as e:  # noqa: BLE001 — probes must not die
+                LOG("WARNING", "fleet.router: probe pass failed: %s", e)
+
+    def probe_now(self) -> None:
+        """One membership-refresh + health-probe pass (also callable
+        from tests/drills to skip the probe interval)."""
+        endpoints = self._tracker.serve_endpoints()
+        results: Dict[int, Tuple[str, Dict[str, Any]]] = {}
+        for rank, url in endpoints.items():
+            try:
+                _, _, body = http_request(
+                    "GET", url + "/healthz", retry=_ONE_ATTEMPT,
+                    op="fleet_probe")
+                results[rank] = (url, json.loads(body))
+            except Exception:  # noqa: BLE001 — unreachable == unhealthy
+                results[rank] = (url, {"status": "unreachable"})
+        with self._lock:
+            before = self._routable_locked()
+            for rank in list(self._replicas):
+                if rank not in results:
+                    del self._replicas[rank]
+            for rank, (url, doc) in results.items():
+                st = self._replicas.get(rank)
+                if st is None or st.url != url:
+                    st = self._replicas[rank] = _ReplicaState(rank, url)
+                st.status = str(doc.get("status", "unreachable"))
+                st.healthy = st.status == "ok"
+                st.queue_depth = int(doc.get("queue_depth") or 0)
+                st.version = doc.get("version")
+            after = self._routable_locked()
+            if after != before:
+                self._ring = HashRing(after)
+                LOG("INFO", "fleet.router: routable set now %s", after)
+            depth = sum(self._replicas[r].queue_depth for r in after)
+        if _metrics.enabled():
+            m = fleet_metrics()
+            m["healthy"].set(len(after))
+            m["queue_depth"].set(depth)
+
+    def _routable_locked(self) -> List[int]:
+        return sorted(r for r, st in self._replicas.items() if st.healthy)
+
+    def replica_docs(self) -> Dict[int, Dict[str, Any]]:
+        """Router-side state per replica (health doc for ``/healthz``)."""
+        with self._lock:
+            return {r: st.doc() for r, st in self._replicas.items()}
+
+    # -- routing ---------------------------------------------------------
+    def _observe(self, path: str, code: int, seconds: float) -> None:
+        if _metrics.enabled():
+            p = path if path in ("/predict", "/healthz", "/metrics") else "other"
+            fleet_metrics()["router_e2e"].observe(seconds, path=p)
+
+    def _route(self, method: str, path: str, body: bytes
+               ) -> Tuple[int, Any, str, Dict[str, str]]:
+        if path == "/predict":
+            if method != "POST":
+                return 405, {"error": "POST only"}, "application/json", {}
+            return self._route_predict(body)
+        if path == "/healthz":
+            docs = self.replica_docs()
+            healthy = sum(1 for d in docs.values() if d["healthy"])
+            return (200, {"status": "ok" if healthy else "no_replicas",
+                          "healthy": healthy,
+                          "replicas": {str(r): d for r, d in docs.items()}},
+                    "application/json", {})
+        if path == "/metrics":
+            text = _metrics.default_registry().to_prometheus()
+            return (200, text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8", {})
+        return super()._route(method, path, body)
+
+    def _route_predict(self, body: bytes
+                       ) -> Tuple[int, Any, str, Dict[str, str]]:
+        m = fleet_metrics() if _metrics.enabled() else None
+        with self._lock:
+            routable = self._routable_locked()
+            ring = self._ring
+            depth = sum(self._replicas[r].queue_depth for r in routable)
+            candidates = [(r, self._replicas[r].url,
+                           self._replicas[r].breaker)
+                          for r in ring.sequence(body)
+                          if r in routable][:1 + self.failover]
+        if not candidates:
+            if m:
+                m["shed"].inc(1, reason="no_replicas")
+            return (503, {"error": "no healthy replicas"},
+                    "application/json", {"Retry-After": "1"})
+        if depth > self.max_queue:
+            if m:
+                m["shed"].inc(1, reason="queue")
+            return (503, {"error": f"fleet queue depth {depth} > "
+                                   f"{self.max_queue}"},
+                    "application/json", {"Retry-After": "1"})
+        last_shed: Optional[HttpError] = None
+        for rank, url, breaker in candidates:
+            if not breaker.allow():
+                if m:
+                    m["failover"].inc(1, reason="open")
+                continue
+            try:
+                _, _, data = http_request(
+                    "POST", url + "/predict",
+                    {"Content-Type": "application/json"}, body,
+                    ok=(200,), retry=_ONE_ATTEMPT, idempotent=True,
+                    op="fleet_route")
+            except HttpError as e:
+                if e.status == 503:
+                    # alive-but-shedding: NOT a breaker failure (see
+                    # module docstring) — walk to the next replica
+                    breaker.record_success()
+                    last_shed = e
+                    if m:
+                        m["failover"].inc(1, reason="shed")
+                    continue
+                if 400 <= e.status < 500 and e.status not in (408, 429):
+                    # the request's own fault — identical everywhere,
+                    # pass the replica's verdict through
+                    return (e.status, e.body, "application/json", {})
+                breaker.record_failure()
+                if m:
+                    m["failover"].inc(1, reason="transport")
+                continue
+            except Exception:  # noqa: BLE001 — refused/reset/timeout
+                breaker.record_failure()
+                self._mark_unhealthy(rank)
+                if m:
+                    m["failover"].inc(1, reason="transport")
+                continue
+            breaker.record_success()
+            if m:
+                m["routed"].inc(1, replica=str(rank))
+            return 200, data, "application/json", {}
+        if last_shed is not None:
+            retry_after = last_shed.retry_after
+            hdrs = {"Retry-After": str(retry_after if retry_after
+                                       is not None else 1)}
+            return 503, last_shed.body, "application/json", hdrs
+        return (502, {"error": "no replica answered"},
+                "application/json", {"Retry-After": "1"})
+
+    def _mark_unhealthy(self, rank: int) -> None:
+        """Drop a replica from the ring immediately after a transport
+        failure — the next probe pass re-adds it if it recovered."""
+        with self._lock:
+            st = self._replicas.get(rank)
+            if st is not None and st.healthy:
+                st.healthy = False
+                st.status = "unreachable"
+                self._ring = HashRing(self._routable_locked())

@@ -8,6 +8,10 @@ protocol is minimal HTTP/1.1 (one request per connection,
 the hard problems — batching, admission control, hot-swap — live behind
 the socket, not in it.
 
+The socket plumbing is factored into :class:`HttpServer` so the fleet
+tier (``serve.fleet.router.FleetRouter``, the replica admin surface)
+reuses one audited request loop instead of three copies of it.
+
 Routes:
 
 * ``POST /predict`` — body ``{"rows": [[...], ...]}`` (one request may
@@ -17,7 +21,12 @@ Routes:
   version that executed the batch.  A full queue answers **503**
   immediately (admission control with ``Retry-After``), an expired
   request **504**, a malformed body **400**.
-* ``GET /healthz`` — liveness + current model version + queue depth.
+* ``POST /drain`` — stop admitting new predicts (503 + ``Retry-After``)
+  while in-flight and queued requests finish; ``/healthz`` flips to
+  ``draining``.  This is the zero-downtime retire path: a router stops
+  sending traffic on the health flip, then the process exits clean.
+* ``GET /healthz`` — liveness + current model version + queue depth +
+  in-flight request count.
 * ``GET /metrics`` — Prometheus text exposition of the process-wide
   registry (``base.metrics.default_registry``): every serve instrument
   plus whatever training/io metrics the process has recorded.
@@ -45,35 +54,31 @@ from dmlc_core_tpu.serve.batcher import (BatcherClosedError, DynamicBatcher,
 from dmlc_core_tpu.serve.instruments import serve_metrics
 from dmlc_core_tpu.serve.registry import ModelRegistry
 
-__all__ = ["ServeFrontend"]
+__all__ = ["HttpServer", "ServeFrontend"]
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 408: "Request Timeout",
-            500: "Internal Server Error", 503: "Service Unavailable",
-            504: "Gateway Timeout"}
+            500: "Internal Server Error", 502: "Bad Gateway",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
 
 #: request-body cap — a predict batch of max_batch × a few thousand
 #: features in JSON stays far below this; anything bigger is abuse
 _MAX_BODY = 64 << 20
 
 
-class ServeFrontend:
-    """HTTP face of a :class:`ModelRegistry` + :class:`DynamicBatcher`.
+class HttpServer:
+    """Minimal threaded HTTP/1.1 server over raw stdlib sockets.
 
-    The frontend owns the batcher; its execute hook resolves
-    ``registry.current()`` ONCE per batch, so a hot-swap lands between
-    batches and in-flight work finishes on the version it started on.
+    One request per connection (``Connection: close``), a daemon accept
+    loop with a short timeout so :meth:`close` is prompt, one daemon
+    thread per connection — the RabitTracker socket idioms, packaged.
+    Subclasses implement :meth:`_route` (and optionally
+    :meth:`_observe` for per-request instrumentation).
     """
 
-    def __init__(self, registry: ModelRegistry,
-                 host: str = "127.0.0.1", port: int = 0,
-                 max_batch: int = 1024, max_delay: float = 0.002,
-                 max_queue: int = 256, request_timeout: float = 30.0):
-        self.registry = registry
-        self.request_timeout = request_timeout
-        self._batcher = DynamicBatcher(
-            self._execute, max_batch=max_batch, max_delay=max_delay,
-            max_queue=max_queue, name=registry.name)
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "http"):
+        self.name = name
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -89,19 +94,20 @@ class ServeFrontend:
         return f"http://{self.host}:{self.port}"
 
     # -- lifecycle -------------------------------------------------------
-    def start(self) -> "ServeFrontend":
+    def start(self) -> "HttpServer":
         """Begin accepting connections (idempotent)."""
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._accept_loop, daemon=True,
-                name=f"serve-frontend-{self.registry.name}")
+                name=f"http-{self.name}")
             self._thread.start()
-            LOG("INFO", "serve.frontend %s: listening on %s",
-                self.registry.name, self.url)
+            LOG("INFO", "serve.http %s: listening on %s", self.name,
+                self.url)
         return self
 
-    def close(self, drain: bool = True) -> None:
-        """Stop accepting, then drain (or abort) the batcher."""
+    def close(self) -> None:
+        """Stop accepting and join the accept loop.  Connection threads
+        already past accept finish their one request and exit."""
         self._done.set()
         try:
             self._sock.close()
@@ -109,18 +115,22 @@ class ServeFrontend:
             pass
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-        self._batcher.close(drain=drain)
 
-    def __enter__(self) -> "ServeFrontend":
+    def __enter__(self) -> "HttpServer":
         return self.start()
 
     def __exit__(self, *exc: Any) -> None:
         self.close()
 
-    # -- batch execution -------------------------------------------------
-    def _execute(self, X: np.ndarray):
-        version, runner = self.registry.current()
-        return runner.predict(X), version
+    # -- hooks -----------------------------------------------------------
+    def _route(self, method: str, path: str, body: bytes
+               ) -> Tuple[int, Any, str, Dict[str, str]]:
+        """Handle one request → ``(code, payload, content_type,
+        extra_headers)``; ``payload`` is JSON-dumped unless bytes."""
+        return 404, {"error": f"no route {path}"}, "application/json", {}
+
+    def _observe(self, path: str, code: int, seconds: float) -> None:
+        """Per-request instrumentation hook (default: none)."""
 
     # -- socket plumbing (tracker.py idioms) -----------------------------
     def _accept_loop(self) -> None:
@@ -153,14 +163,8 @@ class ServeFrontend:
                 conn.close()
             except OSError:
                 pass
-            if _metrics.enabled() and path != "?":
-                # clamp unknown paths to one label value — client-chosen
-                # URLs must not mint unbounded metric series
-                p = (path if path in ("/predict", "/healthz", "/metrics")
-                     else "other")
-                m = serve_metrics()
-                m["requests"].inc(1, path=p, code=str(code))
-                m["e2e"].observe(get_time() - t0, path=p)
+            if path != "?":
+                self._observe(path, code, get_time() - t0)
 
     @staticmethod
     def _read_request(conn: socket.socket
@@ -204,6 +208,90 @@ class ServeFrontend:
                 f"{extra}Connection: close\r\n\r\n")
         conn.sendall(head.encode("latin-1") + body)
 
+
+class ServeFrontend(HttpServer):
+    """HTTP face of a :class:`ModelRegistry` + :class:`DynamicBatcher`.
+
+    The frontend owns the batcher; its execute hook resolves
+    ``registry.current()`` ONCE per batch, so a hot-swap lands between
+    batches and in-flight work finishes on the version it started on.
+    """
+
+    def __init__(self, registry: ModelRegistry,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 1024, max_delay: float = 0.002,
+                 max_queue: int = 256, request_timeout: float = 30.0):
+        super().__init__(host=host, port=port, name=registry.name)
+        self.registry = registry
+        self.request_timeout = request_timeout
+        self._batcher = DynamicBatcher(
+            self._execute, max_batch=max_batch, max_delay=max_delay,
+            max_queue=max_queue, name=registry.name)
+        #: drain flag: set → new predicts are shed with 503 while queued
+        #: and in-flight work completes (Event: atomic, no lock needed)
+        self._draining = threading.Event()
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServeFrontend":
+        """Begin accepting connections (idempotent)."""
+        super().start()
+        return self
+
+    def drain(self) -> None:
+        """Stop admitting new predicts (they answer 503 + Retry-After);
+        queued and in-flight requests keep executing.  ``/healthz``
+        reports ``draining`` so routers take this replica out of
+        rotation before :meth:`close` retires it."""
+        if not self._draining.is_set():
+            self._draining.set()
+            LOG("INFO", "serve.frontend %s: draining (queue depth %d, "
+                "inflight %d)", self.registry.name,
+                self._batcher.depth(), self.inflight())
+
+    def inflight(self) -> int:
+        """Predict requests currently inside the frontend (accepted but
+        not yet answered) — the in-flight work :meth:`close` waits on."""
+        with self._inflight_lock:
+            return self._inflight
+
+    def close(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Graceful shutdown: stop admitting (drain mode), stop
+        accepting connections, flush the batcher, then wait for every
+        in-flight response to go out before returning.
+        ``drain=False`` aborts queued requests instead of finishing
+        them (their futures get :class:`BatcherClosedError`)."""
+        if drain:
+            self.drain()
+        super().close()
+        self._batcher.close(drain=drain)
+        # batcher futures are resolved; connection threads may still be
+        # serializing responses — bounded wait so "close then exit"
+        # cannot cut a response mid-write
+        deadline = get_time() + timeout
+        while self.inflight() > 0 and get_time() < deadline:
+            self._done.wait(0.01)
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- batch execution -------------------------------------------------
+    def _execute(self, X: np.ndarray):
+        version, runner = self.registry.current()
+        return runner.predict(X), version
+
+    def _observe(self, path: str, code: int, seconds: float) -> None:
+        if _metrics.enabled():
+            # clamp unknown paths to one label value — client-chosen
+            # URLs must not mint unbounded metric series
+            p = (path if path in ("/predict", "/healthz", "/metrics",
+                                  "/drain")
+                 else "other")
+            m = serve_metrics()
+            m["requests"].inc(1, path=p, code=str(code))
+            m["e2e"].observe(seconds, path=p)
+
     # -- routing ---------------------------------------------------------
     def _route(self, method: str, path: str, body: bytes
                ) -> Tuple[int, Any, str, Dict[str, str]]:
@@ -211,20 +299,38 @@ class ServeFrontend:
             if method != "POST":
                 return (405, {"error": "POST only"},
                         "application/json", {})
-            return self._handle_predict(body)
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                return self._handle_predict(body)
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+        if path == "/drain":
+            if method != "POST":
+                return (405, {"error": "POST only"},
+                        "application/json", {})
+            self.drain()
+            return (200, {"status": "draining",
+                          "queue_depth": self._batcher.depth(),
+                          "inflight": self.inflight()},
+                    "application/json", {})
         if path == "/healthz":
             return 200, self._health(), "application/json", {}
         if path == "/metrics":
             text = _metrics.default_registry().to_prometheus()
             return (200, text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", {})
-        return 404, {"error": f"no route {path}"}, "application/json", {}
+        return super()._route(method, path, body)
 
     def _health(self) -> Dict[str, Any]:
         version = self.registry.current_version()
-        out = {"status": "ok" if version is not None else "no_model",
+        status = ("draining" if self._draining.is_set()
+                  else "ok" if version is not None else "no_model")
+        out = {"status": status,
                "version": version,
-               "queue_depth": self._batcher.depth()}
+               "queue_depth": self._batcher.depth(),
+               "inflight": self.inflight()}
         if version is not None:
             runner = self.registry.get(version)
             out["batch_buckets"] = sorted(runner.compiled_shapes)
@@ -238,6 +344,9 @@ class ServeFrontend:
             # would, with an immediate-retry hint so drills stay fast
             return (fault.int_value(503), {"error": "fault injected"},
                     "application/json", {"Retry-After": "0"})
+        if self._draining.is_set():
+            return (503, {"error": "draining"},
+                    "application/json", {"Retry-After": "1"})
         if self.registry.current_version() is None:
             return (503, {"error": "no model published"},
                     "application/json", {"Retry-After": "1"})

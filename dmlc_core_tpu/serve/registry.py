@@ -173,13 +173,18 @@ class ModelRegistry:
                 source=source or type(model).__name__)
         return version
 
-    def load(self, uri: str) -> int:
+    def load(self, uri: str, activate: bool = True) -> int:
         """Load a serving checkpoint from any Stream URI and publish it
         under the checkpoint's own version (hot-swap path).  A missing
-        checkpoint is a loud error — serving has no cold-start state."""
+        checkpoint is a loud error — serving has no cold-start state.
+
+        ``activate=False`` stages the version instead of switching
+        traffic to it — the fleet rollout's publish-everywhere-first
+        step (doc/serving.md, Fleet section)."""
         version, model = load_model_checkpoint(uri)
         CHECK(model is not None, f"no model checkpoint at {uri}")
-        return self.publish(model, version=version, source=uri)
+        return self.publish(model, version=version, source=uri,
+                            activate=activate)
 
     def save(self, uri: str, version: Optional[int] = None) -> None:
         """Checkpoint a retained version (default: current) to ``uri``."""

@@ -383,7 +383,21 @@ class RabitTracker:
                 "ring_prev": link["ring_prev"],
                 "ring_next": link["ring_next"],
             }
+        ext = self._handle_ext(cmd, msg, conn, state)
+        if ext is not None:
+            return ext
         return {"error": f"unknown cmd {cmd!r}"}
+
+    def _handle_ext(self, cmd: Any, msg: Dict[str, Any],
+                    conn: Optional[socket.socket],
+                    state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Hook: handle a subclass-specific command.  Called for any cmd
+        the base protocol does not know; return a reply dict to claim
+        it, or None to let the base answer ``unknown cmd`` — how the
+        fleet tracker (``serve.fleet.replica.FleetTracker``) adds
+        ``serve_register``/``serve_report`` without forking the
+        dispatch."""
+        return None
 
     def join(self, timeout: Optional[float] = None) -> bool:
         """Block until all workers sent 'shutdown'.
@@ -463,6 +477,13 @@ class WorkerSession:
                 log_fatal("tracker connection closed mid-request")
             buf += data
         return json.loads(buf.split(b"\n", 1)[0])
+
+    def request(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """One JSON request/reply round trip on the persistent socket —
+        the worker half of any subclass command a tracker's
+        ``_handle_ext`` hook serves (e.g. the fleet's
+        ``serve_register``/``serve_report``)."""
+        return self._request(msg)
 
     def print_msg(self, text: str) -> None:
         self._sock.sendall(json.dumps({"cmd": "print", "msg": text}).encode() + b"\n")

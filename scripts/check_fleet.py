@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Fleet-serving chaos drill for CI: kill, reroute, rescale, rollout.
+
+Stands up the full fleet topology — FleetTracker + 3 subprocess
+replicas (v1 checkpoint) + in-process consistent-hash router — then
+drives it through the incidents the fleet tier exists to absorb, with
+closed-loop verified load running THROUGH every incident:
+
+1. **Kill** — SIGKILL one replica mid-traffic.  The router must fail
+   predicts over to surviving replicas (zero dropped, zero wrong), the
+   tracker must record the death, and the victim must leave the
+   routable set.
+2. **Rescale** — the local autoscale backend spawns a replacement
+   replica; it registers and joins the routable set.
+3. **Rollout** — staged v1→v2 deploy (wave size 1) under load: every
+   response bit-matches the version it claims, no request is dropped,
+   each replica's observed version sequence is monotone, and the fleet
+   converges on v2.
+
+The JSON report (counts, latencies, per-phase verdicts) is archived to
+``FLEET_OUT`` (default ``/tmp/fleet_drill.json``) for CI artifacts.
+Parent runs under ``DMLC_LOCKCHECK=1`` and verifies zero lock-order
+cycles.  Exit 0 = drill green.  Usage:
+    python scripts/check_fleet.py
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_REPLICAS = 3
+N_ROWS, N_FEAT = 400, 8
+LOAD_S = 6.0
+
+
+def _check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def _wait(pred, timeout_s, label):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    _check(False, f"timed out waiting for {label}")
+
+
+def main() -> None:
+    os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    from dmlc_core_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    import numpy as np
+
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.serve import checkpoint_model
+    from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
+                                           HttpFleetAdmin,
+                                           LocalProcessScaler, Rollout,
+                                           run_loadgen, spawn_replica)
+    from dmlc_core_tpu.serve.client import ResilientClient
+
+    out_path = os.environ.get("FLEET_OUT", "/tmp/fleet_drill.json")
+    report = {"phases": {}}
+    tmp = tempfile.mkdtemp(prefix="dmlc_fleet")
+
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(N_ROWS, N_FEAT)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    m1 = HistGBT(n_trees=4, max_depth=3, n_bins=16).fit(X, y)
+    m2 = HistGBT(n_trees=8, max_depth=3, n_bins=16).fit(X, y)
+    v1_uri = f"file://{tmp}/v1.ckpt"
+    v2_uri = f"file://{tmp}/v2.ckpt"
+    checkpoint_model(v1_uri, m1, version=1)
+    checkpoint_model(v2_uri, m2, version=2)
+    expected_npz = os.path.join(tmp, "expected.npz")
+    np.savez(expected_npz, X=X, v1=m1.predict(X), v2=m2.predict(X))
+
+    child_env = {"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
+                 "DMLC_LOCKCHECK": "1"}
+    tracker = FleetTracker(nworker=8)
+    tracker.start()
+    procs = [spawn_replica("127.0.0.1", tracker.port, model_uri=v1_uri,
+                           max_batch=32, extra_env=child_env)
+             for _ in range(N_REPLICAS)]
+    scaler = LocalProcessScaler(tracker, v1_uri, spawn_env=child_env)
+    router = None
+    try:
+        _wait(lambda: len(tracker.serve_endpoints()) == N_REPLICAS,
+              180, "replica registration")
+        _check(True, f"{N_REPLICAS} replicas registered with the tracker")
+        router = FleetRouter(tracker, probe_s=0.2).start()
+
+        client = ResilientClient(router.url)
+        preds, ver = client.predict(X[:8])
+        _check(ver == 1 and np.array_equal(preds, m1.predict(X)[:8]),
+               "routed predict bit-identical to direct v1 predict")
+
+        def _loadgen_bg(result, duration):
+            result.update(run_loadgen(
+                router.url, expected_npz, duration_s=duration, procs=2,
+                threads=3, base_qps=60.0, timeout_ms=10_000,
+                workdir=tmp, env=child_env))
+
+        # -- phase 1: SIGKILL one replica mid-traffic --------------------
+        load1 = {}
+        t1 = threading.Thread(target=_loadgen_bg, args=(load1, LOAD_S))
+        t1.start()
+        time.sleep(LOAD_S / 3.0)
+        victim = procs[1]
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=30)
+        _check(victim.returncode == -signal.SIGKILL,
+               f"victim replica SIGKILLed (rc={victim.returncode})")
+        _wait(lambda: len(tracker.serve_endpoints()) == N_REPLICAS - 1,
+              60, "tracker dropping the dead endpoint")
+        _check(tracker.dead_workers,
+               f"tracker recorded the death (ranks {tracker.dead_workers})")
+        t1.join(timeout=LOAD_S + 180)
+        _check(not t1.is_alive(), "kill-phase load generator finished")
+        _check(load1.get("dropped") == 0 and load1.get("wrong") == 0,
+               f"kill under load: zero dropped / zero wrong "
+               f"({load1.get('ok')} ok of {load1.get('count')})")
+        router.probe_now()
+        docs = router.replica_docs()
+        healthy = sorted(r for r, d in docs.items() if d["healthy"])
+        _check(len(healthy) == N_REPLICAS - 1,
+               f"router routable set shrank to survivors {healthy}")
+        report["phases"]["kill"] = {"load": load1,
+                                    "dead": list(tracker.dead_workers)}
+
+        # -- phase 2: autoscale backend spawns a replacement -------------
+        scaler.scale(1)
+        _wait(lambda: len(tracker.serve_endpoints()) == N_REPLICAS,
+              180, "scaled-out replica registration")
+        router.probe_now()
+        healthy = sorted(r for r, d in router.replica_docs().items()
+                         if d["healthy"])
+        _check(len(healthy) == N_REPLICAS,
+               f"autoscale spawn path restored the fleet {healthy}")
+        report["phases"]["rescale"] = {"healthy": healthy}
+
+        # -- phase 3: staged rollout v1 -> v2 under load ------------------
+        endpoints = dict(tracker.serve_endpoints())
+        versions_seen = {r: [] for r in endpoints}
+        stop_watch = threading.Event()
+
+        def _watch():
+            cs = {r: ResilientClient(u) for r, u in endpoints.items()}
+            while not stop_watch.is_set():
+                for r, c in cs.items():
+                    try:
+                        v = c.healthz().get("version")
+                        if v is not None:
+                            versions_seen[r].append(int(v))
+                    except Exception:  # noqa: BLE001 — probe best-effort
+                        pass
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+        load2 = {}
+        t2 = threading.Thread(target=_loadgen_bg, args=(load2, LOAD_S))
+        t2.start()
+        time.sleep(LOAD_S / 4.0)
+        rollout = Rollout(HttpFleetAdmin(endpoints), wave_size=1,
+                          settle_s=0.3).run(v2_uri)
+        _check(rollout["outcome"] == "activated",
+               f"staged rollout activated v{rollout['version']} in "
+               f"{len(rollout['waves'])} waves of 1")
+        t2.join(timeout=LOAD_S + 180)
+        _check(not t2.is_alive(), "rollout-phase load generator finished")
+        stop_watch.set()
+        watcher.join(timeout=30)
+        _check(load2.get("dropped") == 0 and load2.get("wrong") == 0,
+               f"rollout under load: zero dropped / zero wrong "
+               f"({load2.get('ok')} ok of {load2.get('count')})")
+        _check("2" in load2.get("by_version", {}),
+               f"v2 served live traffic ({load2.get('by_version')})")
+        for r, seq in versions_seen.items():
+            _check(seq == sorted(seq),
+                   f"replica {r} version sequence monotone "
+                   f"({seq[0] if seq else '?'}→{seq[-1] if seq else '?'})")
+        final = {r: ResilientClient(u).healthz().get("version")
+                 for r, u in endpoints.items()}
+        _check(all(v == rollout["version"] for v in final.values()),
+               f"fleet converged on v{rollout['version']} ({final})")
+        report["phases"]["rollout"] = {"load": load2, "rollout": rollout,
+                                       "final_versions": final}
+    finally:
+        if router is not None:
+            router.close()
+        scaler.reap(timeout=15)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=15)
+                except Exception:  # noqa: BLE001
+                    p.kill()
+        tracker.stop()
+
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"   report archived to {out_path}")
+    lockcheck.check()
+    print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    print("FLEET CHAOS DRILL GREEN")
+
+
+if __name__ == "__main__":
+    main()

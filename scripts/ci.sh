@@ -103,6 +103,17 @@ echo "== elastic recovery chaos drill (die / rejoin / catch-up + evict) =="
 # (doc/robustness.md "Distributed recovery").
 env JAX_PLATFORMS=cpu python scripts/check_elastic.py
 
+echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
+# 3 subprocess replicas behind the consistent-hash router with verified
+# closed-loop load running through every incident: SIGKILL one replica
+# (router fails over, tracker records the death, zero dropped / zero
+# wrong), the local autoscale backend respawns it, then a staged v1->v2
+# rollout under load must keep per-replica versions monotone and land
+# the whole fleet on v2 — still zero dropped / zero wrong.  The JSON
+# report is archived; parent runs under DMLC_LOCKCHECK=1 with zero
+# order cycles (doc/serving.md "Fleet serving").
+env JAX_PLATFORMS=cpu python scripts/check_fleet.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"
