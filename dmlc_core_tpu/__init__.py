@@ -74,6 +74,16 @@ if _lockcheck.env_enabled():
     # doc/static_analysis.md).
     _lockcheck.install()
 
+from dmlc_core_tpu.base import racecheck as _racecheck
+
+if _racecheck.env_enabled():
+    # DMLC_RACECHECK=1: vector-clock happens-before race detection over
+    # the opt-in classes (tracker/router/batcher/autoscaler/registry/
+    # ConcurrentBlockingQueue); implies lockcheck (traced locks are the
+    # HB vocabulary).  Races are reported via base.racecheck.races()/
+    # check() (see doc/static_analysis.md).
+    _racecheck.install()
+
 from dmlc_core_tpu.base.logging import (  # noqa: F401
     Error,
     LOG,

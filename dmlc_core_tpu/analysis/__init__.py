@@ -15,10 +15,10 @@ guide; the dynamic counterpart of the lock pass lives in
 
 from dmlc_core_tpu.analysis.engine import (
     ALL_RULES, AnalysisContext, Finding, analyze, default_files,
-    load_baseline, write_baseline,
+    load_baseline, rule_help, write_baseline,
 )
 
 __all__ = [
     "ALL_RULES", "AnalysisContext", "Finding", "analyze", "default_files",
-    "load_baseline", "write_baseline",
+    "load_baseline", "rule_help", "write_baseline",
 ]

@@ -1,0 +1,235 @@
+"""``atomicity`` pass: lost-update shapes on mixed-locking attributes.
+
+``lock-discipline`` (PR 5) answers *"is every access to locked state
+under the lock?"*.  This pass answers the sharper question races are
+actually made of: *"is a compound — read-modify-write or
+check-then-act — executed unlocked on an attribute the class locks
+elsewhere?"*.  Scope is the same: classes in ``dmlc_core_tpu/`` that
+own a ``Lock``/``RLock``/``Condition`` attribute.
+
+For each ``self._*`` attribute with MIXED discipline — at least one
+access inside ``with self.<lock>:`` (or a ``*_locked`` method) and at
+least one outside (``__init__`` excluded; construction happens-before
+publication) — the pass flags, when they happen *outside* the lock:
+
+* **read-modify-write**: ``self._x += ...``, or
+  ``self._x = <expr reading self._x>`` — two threads interleave
+  between the read and the store and one update is lost;
+* **check-then-act**: an ``if`` whose test reads ``self._x`` and whose
+  body (or ``else``) *writes* ``self._x`` — the state can change
+  between the check and the act.
+
+Attributes that are never locked anywhere are not flagged (lock-free
+designs are a choice, not an accident); neither are compound ops that
+sit entirely inside the lock.  Intentional unlocked compounds carry
+``# dmlcheck: off:atomicity`` plus a rationale, mirroring the registry
+hot-path suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+from dmlc_core_tpu.analysis.locks import (_MUTATORS, _class_lock_attrs,
+                                          _self_attr)
+
+__all__ = ["run", "EXPLAIN"]
+
+EXPLAIN = {
+    "atomicity": {
+        "doc": "Read-modify-write (`self._x += ...`, "
+               "`self._x = self._x + ...`) or check-then-act "
+               "(`if self._x: ... self._x = ...`) executed OUTSIDE the "
+               "lock on an attribute the class locks elsewhere — the "
+               "compound is not atomic, so interleaving threads lose "
+               "updates or act on stale checks.  Attributes that are "
+               "never locked anywhere are not flagged.",
+        "flagged": (
+            "class Counter:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "    def snapshot(self):\n"
+            "        with self._lock:\n"
+            "            return self._n\n"
+            "    def bump(self):\n"
+            "        self._n += 1        # unlocked RMW: updates lost\n"),
+        "clean": (
+            "class Counter:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "    def snapshot(self):\n"
+            "        with self._lock:\n"
+            "            return self._n\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1    # compound is atomic now\n"),
+    },
+}
+
+
+def _reads_of(node: ast.AST) -> Set[str]:
+    """Names of every ``self._x`` read anywhere under ``node``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            attr = _self_attr(n)
+            if attr:
+                out.add(attr)
+    return out
+
+
+def _writes_of(node: ast.AST) -> Set[str]:
+    """Names of every ``self._x`` written / aug-assigned / mutated
+    (container store, mutator-method call) anywhere under ``node``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr(n)
+            if attr:
+                out.add(attr)
+        elif isinstance(n, ast.AugAssign):
+            attr = _self_attr(n.target)
+            if attr:
+                out.add(attr)
+        elif (isinstance(n, ast.Subscript)
+              and isinstance(n.ctx, (ast.Store, ast.Del))):
+            attr = _self_attr(n.value)
+            if attr:
+                out.add(attr)
+        elif (isinstance(n, ast.Call)
+              and isinstance(n.func, ast.Attribute)
+              and n.func.attr in _MUTATORS):
+            attr = _self_attr(n.func.value)
+            if attr:
+                out.add(attr)
+    return out
+
+
+class _Compound:
+    """One RMW / check-then-act occurrence on a ``self._*`` attribute."""
+
+    __slots__ = ("attr", "line", "held", "in_init", "kind", "method")
+
+    def __init__(self, attr: str, line: int, held: bool, in_init: bool,
+                 kind: str, method: str) -> None:
+        self.attr = attr
+        self.line = line
+        self.held = held
+        self.in_init = in_init
+        self.kind = kind                      # "rmw" | "check-then-act"
+        self.method = method
+
+
+class _AtomicityScanner(ast.NodeVisitor):
+    """Collect accesses + compound shapes for one method."""
+
+    def __init__(self, lock_attrs: Set[str], method: str) -> None:
+        self.lock_attrs = lock_attrs
+        self.method = method
+        self.in_init = method in ("__init__", "__new__")
+        self.held_depth = 1 if method.endswith("_locked") else 0
+        #: attr -> set of held-states seen (True/False), init excluded
+        self.access_held: Dict[str, Set[bool]] = {}
+        self.compounds: List[_Compound] = []
+
+    def _note_access(self, attr: str) -> None:
+        if attr and attr not in self.lock_attrs and not self.in_init:
+            self.access_held.setdefault(attr, set()).add(
+                self.held_depth > 0)
+
+    def _note_compound(self, attr: str, line: int, kind: str) -> None:
+        if attr and attr not in self.lock_attrs:
+            self.compounds.append(_Compound(
+                attr, line, self.held_depth > 0, self.in_init, kind,
+                self.method))
+
+    def visit_With(self, node: ast.With) -> None:
+        locks_here = sum(
+            1 for item in node.items
+            if _self_attr(item.context_expr) in self.lock_attrs)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held_depth += locks_here
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held_depth -= locks_here
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self._note_access(_self_attr(node))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = _self_attr(node.target)
+        if attr:
+            self._note_access(attr)
+            self._note_compound(attr, node.lineno, "rmw")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        reads = _reads_of(node.value)
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr and attr in reads:
+                self._note_compound(attr, node.lineno, "rmw")
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        checked = _reads_of(node.test)
+        if checked:
+            acted = _writes_of(ast.Module(body=node.body, type_ignores=[]))
+            if node.orelse:
+                acted |= _writes_of(
+                    ast.Module(body=node.orelse, type_ignores=[]))
+            for attr in sorted(checked & acted):
+                self._note_compound(attr, node.lineno, "check-then-act")
+        self.generic_visit(node)
+
+
+def _check_class(ctx: AnalysisContext, pf: ParsedFile,
+                 cls: ast.ClassDef) -> None:
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return
+    access_held: Dict[str, Set[bool]] = {}
+    compounds: List[_Compound] = []
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sc = _AtomicityScanner(lock_attrs, item.name)
+            for stmt in item.body:
+                sc.visit(stmt)
+            for attr, held_states in sc.access_held.items():
+                access_held.setdefault(attr, set()).update(held_states)
+            compounds.extend(sc.compounds)
+    mixed = {a for a, hs in access_held.items() if hs == {True, False}}
+    seen: Set[Tuple[str, int]] = set()
+    for c in compounds:
+        if (c.attr in mixed and not c.held and not c.in_init
+                and (c.attr, c.line) not in seen):
+            seen.add((c.attr, c.line))
+            what = ("read-modify-write" if c.kind == "rmw"
+                    else "check-then-act")
+            ctx.add(pf, c.line, "atomicity",
+                    f"{cls.name}.{c.attr} is locked elsewhere but "
+                    f"{c.method}() runs an unlocked {what} on it — the "
+                    f"compound is not atomic",
+                    key=f"{cls.name}.{c.attr}:{c.method}:{c.kind}")
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    """Run the ``atomicity`` pass over every parsed repo file."""
+    if "atomicity" not in selected:
+        return
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")):
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class(ctx, pf, node)

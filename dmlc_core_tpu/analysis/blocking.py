@@ -1,0 +1,211 @@
+"""``lock-blocking`` pass: no blocking calls while a lock is held.
+
+Scope: the same lock-owning classes the ``lock-discipline`` pass walks
+(classes in ``dmlc_core_tpu/`` owning a ``Lock``/``RLock``/``Condition``
+attribute).  Inside a ``with self.<lock>:`` block — or anywhere in a
+``*_locked`` method, whose name asserts the caller holds the lock — the
+pass flags calls that can block for unbounded (or merely *long*) time
+while every other thread queues on the monitor:
+
+* ``time.sleep(...)`` — sleeping under a lock serializes the world;
+* socket ops: ``.recv`` / ``.recvfrom`` / ``.recv_into`` / ``.accept``
+  / ``.connect`` / ``.sendall`` — network time under a lock;
+* HTTP helpers: ``http_request(...)`` / ``urlopen(...)``;
+* subprocess waits: ``subprocess.run/call/check_call/check_output``,
+  ``.communicate()``, ``os.waitpid``;
+* ``.wait()`` with NO timeout on anything that is not one of the
+  class's own condition variables (a ``Condition.wait`` **releases**
+  the monitor it was built on — that is the one wait that belongs
+  under the lock; an ``Event.wait()`` does not release anything);
+* ``.join()`` with no arguments (thread/process join — ``str.join``
+  always takes the iterable, so a zero-arg ``.join()`` is a blocking
+  join);
+* queue ``.get/.put/.push/.pop`` without a ``timeout=`` (and without
+  ``block=False``) when the receiver *names* a queue (``queue`` in the
+  name, or ``q``/``*_q``) — heuristic on purpose: ``dict.get(k)`` must
+  not fire.
+
+A timeout argument is accepted as evidence of boundedness; the pass
+checks discipline, not worst-case latency.  Suppress intentional sites
+with ``# dmlcheck: off:lock-blocking`` plus a rationale comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+from dmlc_core_tpu.analysis.locks import _class_lock_attrs, _self_attr
+
+__all__ = ["run", "EXPLAIN"]
+
+_SOCKET_METHODS = {"recv", "recvfrom", "recv_into", "accept", "connect",
+                   "sendall"}
+_HTTP_CALLS = {"http_request", "urlopen"}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output"}
+_QUEUE_METHODS = {"get", "put", "push", "pop"}
+
+EXPLAIN = {
+    "lock-blocking": {
+        "doc": "Blocking call (sleep / socket / HTTP / subprocess wait / "
+               "untimed wait / join / untimed queue op) made while one of "
+               "the class's locks is held — every other thread queues on "
+               "the monitor for the call's full duration.  Condition.wait "
+               "on the class's own condvars is exempt (it releases the "
+               "monitor); a timeout argument is accepted as boundedness.",
+        "flagged": (
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1.0)      # world stops with you\n"),
+        "clean": (
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            todo = list(self._pending)\n"
+            "        time.sleep(1.0)          # sleep outside the lock\n"),
+    },
+}
+
+
+def _call_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _receiver_name(func: ast.expr) -> str:
+    """Last name component of the receiver for ``recv.x(...)``, '' for
+    bare-name calls."""
+    if not isinstance(func, ast.Attribute):
+        return ""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return ""
+
+
+def _has_kw(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)
+
+
+def _kw_is_false(node: ast.Call, name: str) -> bool:
+    for kw in node.keywords:
+        if (kw.arg == name and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False):
+            return True
+    return False
+
+
+def _looks_like_queue(name: str) -> bool:
+    low = name.lower()
+    return "queue" in low or low == "q" or low.endswith("_q")
+
+
+class _BlockingScanner(ast.NodeVisitor):
+    """Flag blocking calls made at ``held_depth > 0`` in one method."""
+
+    def __init__(self, ctx: AnalysisContext, pf: ParsedFile,
+                 cls_name: str, lock_attrs: Set[str], method: str) -> None:
+        self.ctx = ctx
+        self.pf = pf
+        self.cls_name = cls_name
+        self.lock_attrs = lock_attrs
+        self.method = method
+        self.held_depth = 1 if method.endswith("_locked") else 0
+
+    def visit_With(self, node: ast.With) -> None:
+        locks_here = sum(
+            1 for item in node.items
+            if _self_attr(item.context_expr) in self.lock_attrs)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held_depth += locks_here
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held_depth -= locks_here
+
+    def _flag(self, node: ast.Call, what: str) -> None:
+        self.ctx.add(
+            self.pf, node.lineno, "lock-blocking",
+            f"{self.cls_name}.{self.method}() makes a blocking call "
+            f"({what}) while holding a lock — move it outside the "
+            f"critical section or bound it with a timeout",
+            key=f"{self.cls_name}.{self.method}:{what}")
+
+    def _classify(self, node: ast.Call) -> str:
+        """'' when the call cannot block the monitor, else a short tag."""
+        name = _call_name(node.func)
+        recv = _receiver_name(node.func)
+        if name == "sleep" and (recv in ("", "time")):
+            return "time.sleep"
+        if name in _SOCKET_METHODS and recv not in ("", "self"):
+            return f"socket.{name}"
+        if name in _HTTP_CALLS:
+            return name
+        if name in _SUBPROCESS_FUNCS and recv == "subprocess":
+            return f"subprocess.{name}"
+        if name == "waitpid" and recv == "os":
+            return "os.waitpid"
+        if name == "communicate" and not _has_kw(node, "timeout"):
+            return "communicate"
+        if name == "wait":
+            # Condition.wait on the class's own condvars RELEASES the
+            # monitor — that is the one wait that belongs under a lock.
+            if _self_attr(node.func.value) in self.lock_attrs:
+                return ""
+            if node.args or _has_kw(node, "timeout"):
+                return ""                       # bounded
+            return "wait"
+        if name == "join" and not node.args and not _has_kw(node, "timeout"):
+            return "join"
+        if (name in _QUEUE_METHODS
+                and _looks_like_queue(recv or _self_attr(node.func.value))
+                and not _has_kw(node, "timeout")
+                and not _kw_is_false(node, "block")
+                and not _kw_is_false(node, "blocking")):
+            return f"queue.{name}"
+        return ""
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held_depth > 0:
+            what = self._classify(node)
+            if what:
+                self._flag(node, what)
+        self.generic_visit(node)
+
+
+def _check_class(ctx: AnalysisContext, pf: ParsedFile,
+                 cls: ast.ClassDef) -> None:
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sc = _BlockingScanner(ctx, pf, cls.name, lock_attrs, item.name)
+            for stmt in item.body:
+                sc.visit(stmt)
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    """Run the ``lock-blocking`` pass over every parsed repo file."""
+    if "lock-blocking" not in selected:
+        return
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")):
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class(ctx, pf, node)

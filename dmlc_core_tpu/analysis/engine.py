@@ -27,19 +27,21 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "ALL_RULES", "AnalysisContext", "Finding", "ParsedFile", "analyze",
-    "default_files", "load_baseline", "write_baseline",
+    "default_files", "load_baseline", "rule_help", "write_baseline",
 ]
 
 #: every rule dmlcheck knows; ``--rules`` selects a subset
 ALL_RULES: Tuple[str, ...] = (
     "syntax", "unused-import", "style",
     "lock-discipline", "lock-release",
+    "lock-blocking", "atomicity",
     "jit-purity",
     "knob-registry", "knob-doc",
     "metric-registry", "metric-doc",
@@ -165,6 +167,9 @@ class AnalysisContext:
     docs: Dict[str, str] = field(default_factory=dict)
     findings: List[Finding] = field(default_factory=list)
     suppressed_count: int = 0
+    #: pass-module name -> wall seconds spent, filled by ``analyze`` so
+    #: the CLI can attribute the 10s CI budget
+    pass_seconds: Dict[str, float] = field(default_factory=dict)
 
     def add(self, pf: ParsedFile, line: int, rule: str, message: str,
             key: str) -> None:
@@ -244,9 +249,11 @@ def analyze(root: str,
             files: Optional[Sequence[Tuple[str, str]]] = None,
             rules: Optional[Sequence[str]] = None) -> AnalysisContext:
     """Parse once, run the selected passes, return the context (findings
-    NOT yet baseline-filtered — the CLI owns that policy)."""
+    NOT yet baseline-filtered — the CLI owns that policy).  Per-pass
+    wall time lands in ``ctx.pass_seconds``."""
     # late imports: engine <-> passes would otherwise cycle
-    from dmlc_core_tpu.analysis import jitpure, locks, registries, style
+    from dmlc_core_tpu.analysis import (atomicity, blocking, jitpure,
+                                        locks, registries, style)
 
     if files is None:
         files = default_files(root)
@@ -254,6 +261,7 @@ def analyze(root: str,
     bad = selected - set(ALL_RULES)
     if bad:
         raise ValueError(f"unknown dmlcheck rule(s): {sorted(bad)}")
+    t0 = time.perf_counter()
     parsed = [
         ParsedFile(p, os.path.relpath(p, root).replace(os.sep, "/"), kind)
         for p, kind in files
@@ -261,18 +269,53 @@ def analyze(root: str,
     ctx = AnalysisContext(root=root, files=parsed)
     ctx.knobs = _load_knob_registry(root, ctx.knobs_rel)
     ctx.docs = _load_docs(root)
+    ctx.pass_seconds["parse"] = time.perf_counter() - t0
+
+    def _timed(name: str, fn, *args) -> None:
+        t = time.perf_counter()
+        fn(*args)
+        ctx.pass_seconds[name] = time.perf_counter() - t
 
     if selected & {"syntax", "unused-import", "style"}:
-        style.run(ctx, selected)
+        _timed("style", style.run, ctx, selected)
     if selected & {"lock-discipline", "lock-release"}:
-        locks.run(ctx, selected)
+        _timed("locks", locks.run, ctx, selected)
+    if "lock-blocking" in selected:
+        _timed("blocking", blocking.run, ctx, selected)
+    if "atomicity" in selected:
+        _timed("atomicity", atomicity.run, ctx, selected)
     if "jit-purity" in selected:
-        jitpure.run(ctx)
+        _timed("jitpure", jitpure.run, ctx)
     if selected & {"knob-registry", "knob-doc", "metric-registry",
                    "metric-doc"}:
-        registries.run(ctx, selected)
+        _timed("registries", registries.run, ctx, selected)
     ctx.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
     return ctx
+
+
+def rule_help(rule: str) -> Dict[str, str]:
+    """``--explain`` payload for ``rule``: the pass's one-paragraph doc
+    plus a minimal flagged/clean source pair.  Falls back to the pass
+    module's docstring for rules without a curated example."""
+    from dmlc_core_tpu.analysis import (atomicity, blocking, jitpure,
+                                        locks, registries, style)
+
+    if rule not in ALL_RULES:
+        raise ValueError(f"unknown dmlcheck rule: {rule}")
+    owners = {
+        "syntax": style, "unused-import": style, "style": style,
+        "lock-discipline": locks, "lock-release": locks,
+        "lock-blocking": blocking, "atomicity": atomicity,
+        "jit-purity": jitpure,
+        "knob-registry": registries, "knob-doc": registries,
+        "metric-registry": registries, "metric-doc": registries,
+    }
+    mod = owners[rule]
+    entry = getattr(mod, "EXPLAIN", {}).get(rule)
+    if entry is None:
+        entry = {"doc": (mod.__doc__ or "").strip(),
+                 "flagged": "", "clean": ""}
+    return dict(entry, rule=rule, module=mod.__name__)
 
 
 # -- baseline ---------------------------------------------------------------

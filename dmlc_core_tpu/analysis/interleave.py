@@ -1,0 +1,946 @@
+"""Schedule-exploration model checker for the concurrency suite.
+
+Fourth layer: dmlcheck proves locking *shape* statically, ``lockcheck``
+proves lock *order* and ``racecheck`` proves *this run* was race-free —
+but none of them explores the runs that did NOT happen.  This module
+does: it runs a small concurrent model under a **cooperative
+scheduler** where only one thread executes at a time and every context
+switch happens at an explicit decision point (traced sync operations,
+instrumented attribute accesses, ``sched.choose``).  The sequence of
+decisions IS the schedule, so schedules are deterministic, replayable
+and enumerable:
+
+* **randomized** exploration — seeded random choices, one schedule per
+  seed;
+* **bounded-exhaustive** exploration — depth-first over the decision
+  tree: replay a prefix, diverge at one decision, run deterministically
+  to completion; every alternative of every visited decision goes on
+  the frontier (classic stateless model checking, bounded by the
+  schedule budget instead of a depth cut).
+
+Time is logical: ``time.monotonic``/``time.sleep``/``get_time`` are
+patched to a scheduler clock that only advances when every task is
+blocked on a deadline (so timeouts fire deterministically and a
+``max_delay=2ms`` batcher flush explores the same schedules as a 2 s
+one).
+
+Built-in models (:func:`builtin_models`) prove the serving stack's
+four core concurrency invariants — CircuitBreaker's single half-open
+probe, the rollout state machine's terminal/ordering contract,
+DynamicBatcher's no-request-lost flush/drain, and ModelRegistry's
+untorn hot-swap — over ``DMLC_INTERLEAVE_SCHEDULES`` (default 200)
+distinct schedules each; ``python -m dmlc_core_tpu.analysis.interleave``
+runs them all (a ci.sh stage).  Do not combine with
+``DMLC_RACECHECK=1``: coop primitives are invisible to racecheck's
+happens-before vocabulary, so it would report false races.
+"""
+
+from __future__ import annotations
+
+import _thread
+import argparse
+import os
+import random
+import sys
+import threading
+import time as _time
+from contextlib import contextmanager
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Tuple)
+
+__all__ = ["Scheduler", "Deadlock", "ScheduleLimit",
+           "InvariantViolation", "ExploreResult", "explore", "verify",
+           "builtin_models", "env_schedules", "main"]
+
+
+class Deadlock(RuntimeError):
+    """Every task is blocked with no pending timeout — the schedule
+    wedged the model."""
+
+
+class ScheduleLimit(RuntimeError):
+    """A single schedule exceeded ``max_steps`` decisions (livelock or
+    a runaway model)."""
+
+
+class InvariantViolation(AssertionError):
+    """:func:`verify` found at least one schedule that breaks the
+    model's invariant; carries the failing decision trace."""
+
+    def __init__(self, message: str, trace: List[int]):
+        super().__init__(message)
+        self.trace = trace
+
+
+class _Abort(BaseException):
+    """Internal: unwinds leftover tasks when a run is torn down."""
+
+
+_RUNNABLE, _BLOCKED, _DONE = "runnable", "blocked", "done"
+#: owner sentinel for sync ops issued outside any scheduled task
+_MAIN = object()
+
+
+class _Task:
+    __slots__ = ("name", "fn", "gate", "state", "deadline", "timed_out",
+                 "exc", "joiners")
+
+    def __init__(self, fn: Callable[[], None], name: str):
+        self.fn = fn
+        self.name = name
+        self.gate = _thread.allocate_lock()
+        # handoff token, not a mutex: starts held; the SCHEDULER
+        # releases it to grant this task a run slice
+        self.gate.acquire()  # dmlcheck: off:lock-release
+        self.state = _RUNNABLE
+        self.deadline: Optional[float] = None
+        self.timed_out = False
+        self.exc: Optional[BaseException] = None
+        self.joiners: List["_Task"] = []
+
+
+def _wake(task: "_Task") -> None:
+    if task.state == _BLOCKED:
+        task.state = _RUNNABLE
+        task.timed_out = False
+
+
+class Scheduler:
+    """One run of a model under one schedule.
+
+    The driving thread (the model function itself) creates tasks —
+    directly via :meth:`spawn` or through ``threading.Thread`` inside
+    :meth:`patched` — then calls :meth:`go`, which runs them one at a
+    time, consulting the ``pick`` callback at every point where more
+    than one task could run next."""
+
+    def __init__(self, pick: Callable[[int, int], int],
+                 max_steps: int = 20000):
+        self._pick = pick
+        self.max_steps = max_steps
+        self.now = 0.0
+        self.trace: List[int] = []
+        self.counts: List[int] = []
+        self._tasks: List[_Task] = []
+        #: binary handshake: released exactly once per task run-slice
+        self._park = _thread.allocate_lock()
+        # handoff token: TASKS release it to return the baton
+        self._park.acquire()  # dmlcheck: off:lock-release
+        self._tls = threading.local()
+        self._aborting = False
+
+    # -- decisions -------------------------------------------------------
+    def choose(self, n: int) -> int:
+        """Record one ``n``-way decision and return the schedule's pick.
+        Models use this directly for nondeterministic inputs (wave
+        outcomes, activate-vs-stage); the scheduler uses it to pick the
+        next task.  ``n <= 1`` is not a decision and is not recorded."""
+        if n <= 1:
+            return 0
+        if len(self.trace) >= self.max_steps:
+            raise ScheduleLimit(
+                f"schedule exceeded {self.max_steps} decisions")
+        i = self._pick(len(self.trace), n)
+        if not 0 <= i < n:
+            i = 0
+        self.trace.append(i)
+        self.counts.append(n)
+        return i
+
+    # -- logical time ----------------------------------------------------
+    def time(self) -> float:
+        """The logical clock (advances only at quiescence)."""
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        """Manually advance the clock (model setup, e.g. lapsing a
+        circuit breaker's reset window)."""
+        self.now += dt
+
+    def sleep(self, dt: float) -> None:
+        t = self._current()
+        if t is None:
+            self.now += dt
+        elif dt > 0:
+            self._block(t, self.now + dt)
+        else:
+            self.point()
+
+    # -- task machinery --------------------------------------------------
+    def _current(self) -> Optional[_Task]:
+        return getattr(self._tls, "task", None)
+
+    def spawn(self, fn: Callable[[], None],
+              name: Optional[str] = None) -> _Task:
+        """Register ``fn`` as a schedulable task (it runs only inside
+        :meth:`go`)."""
+        t = _Task(fn, name or f"task-{len(self._tasks)}")
+        self._tasks.append(t)
+        _thread.start_new_thread(self._body, (t,))
+        return t
+
+    def _body(self, task: _Task) -> None:
+        # token handoff (released by go()), not a critical section
+        task.gate.acquire()  # dmlcheck: off:lock-release
+        self._tls.task = task
+        try:
+            if self._aborting:
+                raise _Abort()
+            task.fn()
+        except _Abort:
+            pass
+        except BaseException as e:  # noqa: BLE001 — reported by go()
+            task.exc = e
+        task.state = _DONE
+        for j in task.joiners:
+            _wake(j)
+        self._park.release()
+
+    def _switch(self, task: _Task) -> None:
+        """Hand the token back to the scheduler; resumes when the
+        scheduler picks this task again."""
+        self._park.release()
+        # token ping-pong: park goes TO the scheduler, gate comes BACK
+        task.gate.acquire()  # dmlcheck: off:lock-release
+        if self._aborting:
+            raise _Abort()
+
+    def point(self) -> None:
+        """A preemption point: the scheduler may switch tasks here.
+        No-op outside scheduled tasks."""
+        t = self._current()
+        if t is None or self._aborting:
+            return
+        t.state = _RUNNABLE
+        self._switch(t)
+
+    def _block(self, task: _Task,
+               deadline: Optional[float] = None) -> bool:
+        """Park ``task`` until something wakes it (True) or its
+        ``deadline`` fires at quiescence (False)."""
+        if self._aborting:
+            raise _Abort()
+        task.state = _BLOCKED
+        task.deadline = deadline
+        task.timed_out = False
+        self._switch(task)
+        task.deadline = None
+        return not task.timed_out
+
+    def go(self) -> None:
+        """Run every task to completion under this schedule; re-raise
+        the first task exception (invariant asserts inside tasks
+        surface here)."""
+        while True:
+            live = [t for t in self._tasks if t.state != _DONE]
+            if not live:
+                break
+            runnable = [t for t in live if t.state == _RUNNABLE]
+            if not runnable:
+                timed = [t for t in live
+                         if t.state == _BLOCKED and t.deadline is not None]
+                if not timed:
+                    self._abort_all()
+                    raise Deadlock(
+                        "all tasks blocked: "
+                        + ", ".join(t.name for t in live))
+                self.now = min(t.deadline for t in timed
+                               if t.deadline is not None)
+                for t in timed:
+                    if t.deadline is not None and t.deadline <= self.now:
+                        t.timed_out = True
+                        t.state = _RUNNABLE
+                continue
+            t = runnable[self.choose(len(runnable))]
+            t.gate.release()
+            # wait for the task to hand the baton back (see _switch)
+            self._park.acquire()  # dmlcheck: off:lock-release
+        for t in self._tasks:
+            if t.exc is not None:
+                raise t.exc
+
+    def _abort_all(self) -> None:
+        """Tear down leftover tasks (failed or abandoned run): each one
+        raises :class:`_Abort` at its next switch point and unwinds."""
+        self._aborting = True
+        for t in self._tasks:
+            while t.state != _DONE:
+                t.gate.release()
+                # same baton handoff as go()'s scheduling loop
+                self._park.acquire()  # dmlcheck: off:lock-release
+
+    # -- patching --------------------------------------------------------
+    @contextmanager
+    def patched(self) -> Iterator["Scheduler"]:
+        """Swap ``threading`` primitives and the ``time`` module for
+        their cooperative twins, so real classes (queues, batchers,
+        breakers) run under this scheduler unmodified."""
+        sched = self
+        saved = (threading.Lock, threading.RLock, threading.Condition,
+                 threading.Event, threading.Thread)
+        saved_time = (_time.monotonic, _time.time, _time.perf_counter,
+                      _time.sleep)
+        threading.Lock = lambda: CoopLock(sched)       # type: ignore
+        threading.RLock = lambda: CoopRLock(sched)     # type: ignore
+        threading.Condition = (                        # type: ignore
+            lambda lock=None: CoopCondition(sched, lock))
+        threading.Event = lambda: CoopEvent(sched)     # type: ignore
+        threading.Thread = (                           # type: ignore
+            lambda *a, **k: CoopThread(sched, *a, **k))
+        _time.monotonic = self.time                    # type: ignore
+        _time.time = self.time                         # type: ignore
+        _time.perf_counter = self.time                 # type: ignore
+        _time.sleep = self.sleep                       # type: ignore
+        try:
+            yield self
+        finally:
+            (threading.Lock, threading.RLock, threading.Condition,
+             threading.Event, threading.Thread) = saved  # type: ignore
+            (_time.monotonic, _time.time, _time.perf_counter,
+             _time.sleep) = saved_time                 # type: ignore
+
+    @contextmanager
+    def attr_points(self, cls: type) -> Iterator[None]:
+        """Make every ``self._x`` instance-attribute access on ``cls``
+        a preemption point — the switches that expose unlocked
+        check-then-act windows (sync-valued attributes excluded)."""
+        orig_get = cls.__getattribute__
+        orig_set = cls.__setattr__
+        sched = self
+
+        def _is_sync(v: Any) -> bool:
+            return isinstance(v, (CoopLock, CoopRLock, CoopCondition,
+                                  CoopEvent, CoopThread))
+
+        def __getattribute__(obj: Any, name: str) -> Any:
+            value = orig_get(obj, name)
+            if (name.startswith("_") and not name.startswith("__")
+                    and sched._current() is not None
+                    and not _is_sync(value)
+                    and name in orig_get(obj, "__dict__")):
+                sched.point()
+            return value
+
+        def __setattr__(obj: Any, name: str, value: Any) -> None:
+            if (name.startswith("_") and not name.startswith("__")
+                    and sched._current() is not None
+                    and not _is_sync(value)):
+                sched.point()
+            orig_set(obj, name, value)
+
+        cls.__getattribute__ = __getattribute__  # type: ignore
+        cls.__setattr__ = __setattr__            # type: ignore
+        try:
+            yield
+        finally:
+            cls.__getattribute__ = orig_get      # type: ignore
+            cls.__setattr__ = orig_set           # type: ignore
+
+
+# -- cooperative primitives -------------------------------------------------
+
+class CoopLock:
+    """``threading.Lock`` twin scheduled by a :class:`Scheduler`."""
+
+    def __init__(self, sched: Scheduler):
+        self._sched = sched
+        self._owner: Any = None
+        self._waiters: List[_Task] = []
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        s = self._sched
+        t: Any = s._current() or _MAIN
+        if t is _MAIN:
+            if self._owner is not None:
+                raise RuntimeError(
+                    "contended acquire outside scheduled tasks")
+            self._owner = t
+            return True
+        s.point()
+        deadline = (s.now + timeout
+                    if timeout is not None and timeout >= 0 else None)
+        while self._owner is not None:
+            if not blocking:
+                return False
+            self._waiters.append(t)
+            ok = s._block(t, deadline)
+            if t in self._waiters:
+                self._waiters.remove(t)
+            if not ok:
+                return False
+        self._owner = t
+        return True
+
+    def release(self) -> None:
+        if self._owner is None:
+            raise RuntimeError("release of unheld CoopLock")
+        self._owner = None
+        for w in self._waiters:
+            _wake(w)
+        if self._sched._current() is not None:
+            self._sched.point()
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+
+class CoopRLock:
+    """``threading.RLock`` twin, with the ``Condition`` protocol."""
+
+    def __init__(self, sched: Scheduler):
+        self._sched = sched
+        self._owner: Any = None
+        self._count = 0
+        self._waiters: List[_Task] = []
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        s = self._sched
+        t: Any = s._current() or _MAIN
+        if self._owner is t:
+            self._count += 1
+            return True
+        if t is _MAIN:
+            if self._owner is not None:
+                raise RuntimeError(
+                    "contended acquire outside scheduled tasks")
+            self._owner, self._count = t, 1
+            return True
+        s.point()
+        deadline = (s.now + timeout
+                    if timeout is not None and timeout >= 0 else None)
+        while self._owner is not None:
+            if not blocking:
+                return False
+            self._waiters.append(t)
+            ok = s._block(t, deadline)
+            if t in self._waiters:
+                self._waiters.remove(t)
+            if not ok:
+                return False
+        self._owner, self._count = t, 1
+        return True
+
+    def release(self) -> None:
+        t: Any = self._sched._current() or _MAIN
+        if self._owner is not t:
+            raise RuntimeError("release of un-owned CoopRLock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            for w in self._waiters:
+                _wake(w)
+            if self._sched._current() is not None:
+                self._sched.point()
+
+    # Condition protocol: wait() drops every recursion level at once
+    def _release_save(self) -> int:
+        count = self._count
+        self._count = 1
+        self.release()
+        return count
+
+    def _acquire_restore(self, count: int) -> None:
+        # Condition wait() protocol: the caller's with-block releases
+        self.acquire()  # dmlcheck: off:lock-release
+        self._count = count
+
+    def _is_owned(self) -> bool:
+        return self._owner is (self._sched._current() or _MAIN)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+
+class CoopCondition:
+    """``threading.Condition`` twin (Mesa semantics, no spurious
+    wakeups beyond notify/timeout)."""
+
+    def __init__(self, sched: Scheduler, lock: Any = None):
+        self._sched = sched
+        self._lock = lock if lock is not None else CoopRLock(sched)
+        self._waiters: List[_Task] = []
+
+    def acquire(self, *a: Any, **k: Any) -> bool:
+        return self._lock.acquire(*a, **k)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "CoopCondition":
+        # the with-statement pairs this with __exit__'s release
+        self._lock.acquire()  # dmlcheck: off:lock-release
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lock.release()
+
+    def _is_owned(self) -> bool:
+        own = getattr(self._lock, "_is_owned", None)
+        return own() if own is not None else True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        s = self._sched
+        t = s._current()
+        if t is None:
+            raise RuntimeError(
+                "Condition.wait outside scheduled tasks would deadlock")
+        if not self._is_owned():
+            raise RuntimeError("wait on un-acquired CoopCondition")
+        self._waiters.append(t)
+        saved = (self._lock._release_save()
+                 if hasattr(self._lock, "_release_save") else None)
+        if saved is None:
+            self._lock.release()
+        deadline = None if timeout is None else s.now + timeout
+        ok = s._block(t, deadline)
+        if t in self._waiters:
+            self._waiters.remove(t)
+        if saved is not None:
+            self._lock._acquire_restore(saved)
+        else:
+            # reacquire after wait; the caller's with-block releases
+            self._lock.acquire()  # dmlcheck: off:lock-release
+        return ok
+
+    def wait_for(self, predicate: Callable[[], Any],
+                 timeout: Optional[float] = None) -> Any:
+        s = self._sched
+        deadline = None if timeout is None else s.now + timeout
+        result = predicate()
+        while not result:
+            remaining = None if deadline is None else deadline - s.now
+            if remaining is not None and remaining <= 0:
+                break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        for w in list(self._waiters[:n]):
+            self._waiters.remove(w)
+            _wake(w)
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters))
+
+
+class CoopEvent:
+    """``threading.Event`` twin."""
+
+    def __init__(self, sched: Scheduler):
+        self._sched = sched
+        self._flag = False
+        self._waiters: List[_Task] = []
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:  # noqa: A003 — stdlib name
+        self._flag = True
+        for w in self._waiters:
+            _wake(w)
+        self._waiters.clear()
+        if self._sched._current() is not None:
+            self._sched.point()
+
+    def clear(self) -> None:
+        self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        s = self._sched
+        t = s._current()
+        if self._flag:
+            if t is not None:
+                s.point()
+            return True
+        if t is None:
+            raise RuntimeError(
+                "Event.wait outside scheduled tasks would deadlock")
+        self._waiters.append(t)
+        s._block(t, None if timeout is None else s.now + timeout)
+        if t in self._waiters:
+            self._waiters.remove(t)
+        return self._flag
+
+
+class CoopThread:
+    """``threading.Thread`` twin: ``start`` registers a task with the
+    scheduler instead of spawning a free-running OS thread."""
+
+    def __init__(self, sched: Scheduler, group: Any = None,
+                 target: Optional[Callable[..., Any]] = None,
+                 name: Optional[str] = None,
+                 args: Tuple[Any, ...] = (),
+                 kwargs: Optional[Dict[str, Any]] = None,
+                 *, daemon: Optional[bool] = None):
+        self._sched = sched
+        self._target = target
+        self._args = args
+        self._kwargs = kwargs or {}
+        self.name = name or f"coop-thread-{id(self):x}"
+        self.daemon = bool(daemon)
+        self._task: Optional[_Task] = None
+
+    def run(self) -> None:
+        if self._target is not None:
+            self._target(*self._args, **self._kwargs)
+
+    def start(self) -> None:
+        if self._task is not None:
+            raise RuntimeError("threads can only be started once")
+        self._task = self._sched.spawn(lambda: self.run(),
+                                       name=self.name)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        s = self._sched
+        task = self._task
+        if task is None:
+            raise RuntimeError("cannot join an un-started thread")
+        t = s._current()
+        if task.state == _DONE:
+            if t is not None:
+                s.point()
+            return
+        if t is None:
+            raise RuntimeError(
+                "join outside scheduled tasks would deadlock")
+        task.joiners.append(t)
+        s._block(t, None if timeout is None else s.now + timeout)
+        if t in task.joiners:
+            task.joiners.remove(t)
+
+    def is_alive(self) -> bool:
+        return self._task is not None and self._task.state != _DONE
+
+
+# -- exploration ------------------------------------------------------------
+
+class ExploreResult:
+    """Outcome of :func:`explore`: how many schedules ran, how many
+    were distinct, which failed, and whether the decision tree was
+    fully exhausted within the budget."""
+
+    def __init__(self, runs: int, distinct: int,
+                 failures: List[Dict[str, Any]], exhausted: bool):
+        self.runs = runs
+        self.distinct = distinct
+        self.failures = failures
+        self.exhausted = exhausted
+
+    def __repr__(self) -> str:
+        return (f"ExploreResult(runs={self.runs}, "
+                f"distinct={self.distinct}, "
+                f"failures={len(self.failures)}, "
+                f"exhausted={self.exhausted})")
+
+
+def _run_once(model: Callable[[Scheduler], None],
+              pick: Callable[[int, int], int], max_steps: int
+              ) -> Tuple[List[int], List[int], Optional[BaseException]]:
+    sched = Scheduler(pick, max_steps)
+    err: Optional[BaseException] = None
+    try:
+        model(sched)
+    except _Abort:
+        err = RuntimeError("model aborted")
+    except Exception as e:  # noqa: BLE001 — every failure is a finding
+        err = e
+    finally:
+        sched._abort_all()
+    return sched.trace, sched.counts, err
+
+
+def _replay_pick(prefix: Tuple[int, ...]) -> Callable[[int, int], int]:
+    def pick(step: int, n: int) -> int:
+        return min(prefix[step], n - 1) if step < len(prefix) else 0
+    return pick
+
+
+def env_schedules() -> int:
+    """The ``DMLC_INTERLEAVE_SCHEDULES`` budget (default 200)."""
+    raw = os.environ.get("DMLC_INTERLEAVE_SCHEDULES", "").strip()
+    return int(raw) if raw else 200
+
+
+def explore(model: Callable[[Scheduler], None],
+            schedules: Optional[int] = None, mode: str = "mixed",
+            seed: int = 0, max_steps: int = 20000) -> ExploreResult:
+    """Run ``model`` under up to ``schedules`` schedules.
+
+    ``mode``: ``"dfs"`` (bounded-exhaustive), ``"random"`` (seeded), or
+    ``"mixed"`` (DFS for half the budget, random for the rest — the
+    default: systematic near the root, probabilistic in the tail)."""
+    if schedules is None:
+        schedules = env_schedules()
+    if mode not in ("dfs", "random", "mixed"):
+        raise ValueError(f"unknown explore mode {mode!r}")
+    traces: set = set()
+    failures: List[Dict[str, Any]] = []
+    runs = 0
+    exhausted = False
+
+    def _record(trace: List[int], err: Optional[BaseException]) -> None:
+        traces.add(tuple(trace))
+        if err is not None:
+            failures.append({"trace": list(trace), "error": err})
+
+    def _dfs_step(stack: List[Tuple[int, ...]]) -> None:
+        nonlocal runs
+        prefix = stack.pop()
+        trace, counts, err = _run_once(
+            model, _replay_pick(prefix), max_steps)
+        runs += 1
+        _record(trace, err)
+        for i in range(len(trace) - 1, len(prefix) - 1, -1):
+            for alt in range(trace[i] + 1, counts[i]):
+                stack.append(tuple(trace[:i]) + (alt,))
+
+    dfs_budget = (schedules if mode == "dfs"
+                  else 0 if mode == "random" else schedules // 2)
+    stack: List[Tuple[int, ...]] = [()] if dfs_budget else []
+    while stack and runs < dfs_budget:
+        _dfs_step(stack)
+    exhausted = dfs_budget > 0 and not stack
+    if not exhausted:
+        for k in range(schedules - runs):
+            rng = random.Random(seed * 1_000_003 + k)
+            trace, _, err = _run_once(
+                model, lambda step, n, r=rng: r.randrange(n), max_steps)
+            runs += 1
+            _record(trace, err)
+    # top-up: every DFS run explores a NEW trace (each frontier prefix
+    # diverges from its parent's schedule), so resuming the frontier
+    # makes up the distinct count that duplicate random draws lost —
+    # unless the whole tree is smaller than the budget
+    while stack and len(traces) < schedules:
+        _dfs_step(stack)
+    exhausted = dfs_budget > 0 and not stack
+    return ExploreResult(runs, len(traces), failures, exhausted)
+
+
+def verify(model: Callable[[Scheduler], None], **kwargs: Any
+           ) -> ExploreResult:
+    """:func:`explore` that raises :class:`InvariantViolation` on the
+    first failing schedule (with its replayable decision trace)."""
+    result = explore(model, **kwargs)
+    if result.failures:
+        f = result.failures[0]
+        raise InvariantViolation(
+            f"{len(result.failures)}/{result.runs} schedules violate "
+            f"the invariant; first: {f['error']!r} under trace "
+            f"{f['trace']}", f["trace"])
+    return result
+
+
+# -- built-in models --------------------------------------------------------
+
+def model_circuit_breaker(sched: Scheduler) -> None:
+    """Half-open circuit admits EXACTLY one probe, no matter how
+    ``allow()`` callers interleave (the PR-5 ``_state`` race, proven
+    absent rather than just not-observed)."""
+    from dmlc_core_tpu.base.resilience import CircuitBreaker
+
+    with sched.patched():
+        cb = CircuitBreaker("interleave", failure_threshold=1,
+                            reset_timeout_s=1.0, clock=sched.time)
+        cb.record_failure()                 # -> OPEN at t=0
+        sched.advance(2.0)                  # reset window lapsed
+        admitted: List[int] = []
+
+        def prober(i: int) -> None:
+            if cb.allow():
+                admitted.append(i)
+
+        for i in range(3):
+            threading.Thread(target=prober, args=(i,)).start()
+        with sched.attr_points(CircuitBreaker):
+            sched.go()
+    assert len(admitted) == 1, (
+        f"half-open circuit admitted {len(admitted)} probes "
+        f"({admitted}); must admit exactly one")
+    assert cb.state == CircuitBreaker.HALF_OPEN
+
+
+def model_rollout(sched: Scheduler) -> None:
+    """Rollout state machine: activation follows plan order without
+    duplicates, terminal state is DONE xor ROLLED_BACK, and rollback
+    targets are exactly the activated replicas in reverse."""
+    from dmlc_core_tpu.serve.fleet.rollout import RolloutController
+
+    n = 4 + sched.choose(8)                  # 4..11 replicas
+    wave_size = 1 + sched.choose(4)          # 1..4 per wave
+    ctl = RolloutController(range(n), wave_size)
+    assert ctl.state == ctl.STAGING
+    ctl.staged()
+    flat = [r for w in ctl.waves for r in w]
+    assert flat == list(range(n))            # plan covers all, in order
+    seen: List[int] = []
+    while True:
+        wave = ctl.next_wave()
+        if wave is None:
+            break
+        outcome = sched.choose(3)            # ok / ok+probe / failed
+        if outcome == 1:
+            assert ctl.state == ctl.ACTIVATING   # probe mid-rollout
+        if outcome in (0, 1):
+            ctl.wave_ok()
+            seen.extend(wave)
+            assert ctl.activated == seen
+        else:
+            rollback = ctl.wave_failed()
+            seen.extend(wave)
+            assert rollback == list(reversed(seen))
+            assert ctl.state == ctl.ROLLED_BACK
+            break
+    if ctl.state != ctl.ROLLED_BACK:
+        assert ctl.state == ctl.DONE
+        assert ctl.activated == list(range(n))
+        assert ctl.next_wave() is None       # DONE is absorbing
+    assert len(set(ctl.activated)) == len(ctl.activated)
+
+
+def model_batcher_flush(sched: Scheduler) -> None:
+    """DynamicBatcher flush/drain: every accepted request resolves
+    exactly once with its own rows' predictions; ``close(drain=True)``
+    loses nothing; the queue ends empty."""
+    import numpy as np
+
+    from dmlc_core_tpu.serve.batcher import DynamicBatcher
+
+    with sched.patched():
+        b = DynamicBatcher(lambda X: X.sum(axis=1), max_batch=4,
+                           max_delay=0.01, max_queue=8,
+                           name="interleave")
+        results: List[Tuple[int, float]] = []
+
+        def client(i: int) -> None:
+            f = b.submit(np.full((1, 2), float(i), np.float32))
+            preds, _ = f.result()
+            results.append((i, float(preds[0])))
+
+        clients = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for th in clients:
+            th.start()
+
+        def closer() -> None:
+            for th in clients:
+                th.join()
+            b.close(drain=True)
+
+        threading.Thread(target=closer).start()
+        sched.go()
+    assert sorted(i for i, _ in results) == [0, 1, 2], (
+        f"requests lost or duplicated: {results}")
+    for i, v in results:
+        assert abs(v - 2.0 * i) < 1e-6, (
+            f"request {i} got another request's rows: {v}")
+    assert b.depth() == 0
+
+
+def model_registry_hot_swap(sched: Scheduler) -> None:
+    """ModelRegistry hot-swap: readers never observe a torn
+    ``(version, runner)`` pair, staged versions stay invisible until
+    activated, and the final pointer is the last activation."""
+    from dmlc_core_tpu.serve import registry as registry_mod
+
+    class _StubRunner:
+        def __init__(self, model: Any, name: str = "default",
+                     **opts: Any):
+            self.model = model
+
+    orig_runner = registry_mod.ModelRunner
+    registry_mod.ModelRunner = _StubRunner  # type: ignore[misc]
+    try:
+        with sched.patched():
+            reg = registry_mod.ModelRegistry("interleave")
+            reg.publish("m1", version=1)
+            observed: List[Tuple[int, Any]] = []
+
+            def publisher() -> None:
+                for v in (2, 3):
+                    staged = sched.choose(2) == 1
+                    reg.publish(f"m{v}", version=v,
+                                activate=not staged)
+                    if staged:
+                        reg.activate(v)
+
+            threading.Thread(target=publisher).start()
+            for k in range(2):
+                def reader() -> None:
+                    for _ in range(3):
+                        ver, runner = reg.current()
+                        observed.append((ver, runner.model))
+                threading.Thread(target=reader).start()
+            with sched.attr_points(registry_mod.ModelRegistry):
+                sched.go()
+    finally:
+        registry_mod.ModelRunner = orig_runner  # type: ignore[misc]
+    for ver, m in observed:
+        assert m == f"m{ver}", (
+            f"torn hot-swap: version {ver} paired with {m!r}")
+        assert ver in (1, 2, 3)
+    assert reg.current()[0] == 3
+    assert reg.versions() == [1, 2, 3]
+
+
+def builtin_models() -> Dict[str, Callable[[Scheduler], None]]:
+    """The four serving-stack invariants the CI interleave stage
+    proves (doc/static_analysis.md)."""
+    return {
+        "circuit-breaker": model_circuit_breaker,
+        "rollout": model_rollout,
+        "batcher": model_batcher_flush,
+        "registry": model_registry_hot_swap,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: explore every built-in model (or ``--model NAME``) over
+    ``--schedules`` schedules; non-zero exit on any violated
+    invariant."""
+    from dmlc_core_tpu.base.logging import set_log_level
+
+    ap = argparse.ArgumentParser(
+        prog="interleave",
+        description="schedule-exploration model checker")
+    ap.add_argument("--model", choices=sorted(builtin_models()),
+                    help="run one model instead of all")
+    ap.add_argument("--schedules", type=int, default=env_schedules(),
+                    help="schedule budget per model "
+                         "(DMLC_INTERLEAVE_SCHEDULES, default 200)")
+    ap.add_argument("--mode", choices=("dfs", "random", "mixed"),
+                    default="mixed")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    set_log_level("ERROR")                # model churn is not news
+    models = builtin_models()
+    names = [args.model] if args.model else sorted(models)
+    rc = 0
+    for name in names:
+        r = explore(models[name], schedules=args.schedules,
+                    mode=args.mode, seed=args.seed)
+        tag = " (tree exhausted)" if r.exhausted else ""
+        print(f"interleave: {name}: {r.runs} schedules, "
+              f"{r.distinct} distinct, {len(r.failures)} failing{tag}")
+        if r.failures:
+            f = r.failures[0]
+            print(f"interleave: {name}: FIRST FAILURE "
+                  f"{f['error']!r} trace={f['trace']}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,14 @@ declare("DMLC_LOCKCHECK", "0",
         "1 installs the dynamic lock-order verifier at import: lock "
         "acquisitions build a cross-thread order graph and cycles are "
         "reported (base/lockcheck).", "observability")
+declare("DMLC_RACECHECK", "0",
+        "1 installs the vector-clock happens-before race detector at "
+        "import (implies lock tracing): shared-attribute accesses on "
+        "the instrumented serving/tracker classes are checked for "
+        "unordered cross-thread pairs (base/racecheck).", "observability")
+declare("DMLC_INTERLEAVE_SCHEDULES", 200,
+        "Schedule budget per model for the interleave model checker "
+        "(analysis/interleave).", "observability")
 
 # -- GBT / compute ----------------------------------------------------------
 declare("DMLC_TPU_ROUNDS_PER_DISPATCH", 25,

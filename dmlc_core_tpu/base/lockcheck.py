@@ -36,7 +36,8 @@ import threading
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 __all__ = ["LockOrderError", "install", "uninstall", "installed",
-           "violations", "reset", "check"]
+           "violations", "reset", "check", "add_listener",
+           "remove_listener"]
 
 
 class LockOrderError(RuntimeError):
@@ -55,7 +56,26 @@ _violations: List[str] = []
 _seen_cycles: Set[frozenset] = set()
 _installed = False
 
+#: observers of traced-lock transitions (``base/racecheck`` layers its
+#: vector clocks on these). Protocol: ``on_acquire(lock, site)`` fires
+#: AFTER the underlying acquire succeeds, ``on_release(lock, site)``
+#: fires BEFORE the underlying release — so a happens-before listener
+#: publishes the holder's state before any other thread can acquire.
+_listeners: List[Any] = []
+
 _tls = threading.local()
+
+
+def add_listener(listener: Any) -> None:
+    """Register a traced-lock observer (see ``_listeners``)."""
+    if listener not in _listeners:
+        _listeners.append(listener)
+
+
+def remove_listener(listener: Any) -> None:
+    """Remove a previously registered observer (no-op if absent)."""
+    if listener in _listeners:
+        _listeners.remove(listener)
 
 
 def _held() -> List[str]:
@@ -137,9 +157,13 @@ class _TracedLock:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             _note_acquire(self._site)
+            for lst in _listeners:
+                lst.on_acquire(self, self._site)
         return ok
 
     def release(self) -> None:
+        for lst in _listeners:
+            lst.on_release(self, self._site)
         self._inner.release()
         _note_release(self._site)
 
@@ -165,6 +189,11 @@ class _TracedRLock(_TracedLock):
     __slots__ = ()
 
     def _release_save(self) -> Any:
+        # Condition.wait drops the monitor: that IS a release for
+        # happens-before purposes, so listeners fire first (publish,
+        # then let waiters in)
+        for lst in _listeners:
+            lst.on_release(self, self._site)
         state = self._inner._release_save()
         # a reentrant owner held this site k times; wait() drops them all
         held = _held()
@@ -180,6 +209,8 @@ class _TracedRLock(_TracedLock):
         self._inner._acquire_restore(inner_state)
         held = _held()
         held.extend([self._site] * k)
+        for lst in _listeners:
+            lst.on_acquire(self, self._site)
 
     def _is_owned(self) -> bool:
         return self._inner._is_owned()

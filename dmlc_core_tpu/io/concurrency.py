@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Any, Generic, List, Optional, Tuple, TypeVar
 
+from dmlc_core_tpu.base.racecheck import instrument_class
+
 __all__ = ["ConcurrentBlockingQueue", "QueueKilled"]
 
 T = TypeVar("T")
@@ -25,6 +27,7 @@ class QueueKilled(Exception):
     """Raised to a blocked producer/consumer after signal_for_kill()."""
 
 
+@instrument_class
 class ConcurrentBlockingQueue(Generic[T]):
     """Bounded blocking MPMC queue.
 

@@ -46,6 +46,7 @@ import numpy as np
 
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK
+from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.io.concurrency import ConcurrentBlockingQueue, QueueKilled
 from dmlc_core_tpu.serve.instruments import serve_metrics
@@ -77,6 +78,7 @@ class _Request:
         self.deadline = None if timeout is None else self.t_enq + timeout
 
 
+@instrument_class
 class DynamicBatcher:
     """Coalesce concurrent predict requests into bounded batches.
 
@@ -97,7 +99,11 @@ class DynamicBatcher:
         self.name = name
         self._queue: ConcurrentBlockingQueue[_Request] = \
             ConcurrentBlockingQueue(max_size=max_queue)
-        self._closed = False
+        # an Event, not a bool: the closed flag is written by the
+        # closing thread and read by submitters AND the flush thread —
+        # set()/is_set() gives that handoff a real happens-before edge
+        # (a bare bool was racecheck's first confirmed finding)
+        self._closed = threading.Event()
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True,
             name=f"serve-batcher-{name}")
@@ -119,7 +125,7 @@ class DynamicBatcher:
         CHECK(rows.ndim == 2 and 1 <= len(rows) <= self.max_batch,
               f"submit: want [k<={self.max_batch}, F] rows, "
               f"got shape {rows.shape}")
-        if self._closed:
+        if self._closed.is_set():
             self._count_reject("closed")
             raise BatcherClosedError("batcher is closed")
         req = _Request(rows, timeout)
@@ -151,7 +157,7 @@ class DynamicBatcher:
                 try:
                     first = self._queue.pop(timeout=_IDLE_POLL_S)
                 except TimeoutError:
-                    if self._closed and self._queue.size() == 0:
+                    if self._closed.is_set() and self._queue.size() == 0:
                         return
                     continue
                 except QueueKilled:
@@ -161,7 +167,7 @@ class DynamicBatcher:
             reason = "deadline"
             deadline = first.t_enq + self.max_delay
             while rows < self.max_batch:
-                if self._closed:
+                if self._closed.is_set():
                     # draining: flush as fast as the queue empties, don't
                     # idle out the deadline on a dead frontend
                     ok, nxt = self._try_pop()
@@ -237,7 +243,7 @@ class DynamicBatcher:
         """Stop admissions; ``drain=True`` completes every queued
         request before returning, ``drain=False`` fails them with
         :class:`BatcherClosedError`.  Idempotent."""
-        self._closed = True
+        self._closed.set()
         if not drain:
             self._queue.signal_for_kill()
         self._thread.join(timeout=timeout)

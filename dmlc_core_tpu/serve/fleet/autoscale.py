@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.resilience import RetryPolicy
 from dmlc_core_tpu.io.http_util import http_request
 from dmlc_core_tpu.serve.fleet.instruments import fleet_metrics
@@ -175,6 +176,7 @@ def fleet_queue_wait_p99(tracker: FleetTracker) -> Optional[float]:
     return max(values) if values else None
 
 
+@instrument_class
 class AutoscaleLoop:
     """Wire signal → policy → metrics/callback/backend on a timer.
 

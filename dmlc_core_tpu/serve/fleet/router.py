@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.resilience import CircuitBreaker, RetryPolicy
 from dmlc_core_tpu.io.http_util import HttpError, http_request
 from dmlc_core_tpu.serve.fleet.instruments import fleet_metrics
@@ -128,6 +129,7 @@ class _ReplicaState:
                 "version": self.version, "breaker": self.breaker.state}
 
 
+@instrument_class
 class FleetRouter(HttpServer):
     """HTTP router/load-balancer over a :class:`FleetTracker`'s fleet.
 

@@ -33,6 +33,7 @@ import numpy as np
 
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.io.stream import Stream
 from dmlc_core_tpu.parallel.checkpoint import checkpoint, load_checkpoint
 from dmlc_core_tpu.serve.instruments import serve_metrics
@@ -126,12 +127,19 @@ def load_model_checkpoint(uri: str) -> Tuple[int, Optional[Any]]:
     return version, _model_from_bytes(np.asarray(state["model"]).tobytes())
 
 
+@instrument_class
 class ModelRegistry:
     """Versioned runners with an atomically swappable current pointer.
 
     ``runner_opts`` (``max_batch``, ``min_bucket``) apply to every
     published model so all versions share one batch-bucket ladder — a
     hot-swap must not change which shapes the batcher produces."""
+
+    #: ``_current`` is read lock-free BY DESIGN (one atomic reference
+    #: fetch of an immutable tuple — see current()); the same rationale
+    #: as its ``# dmlcheck: off:lock-discipline`` suppressions, spelled
+    #: in racecheck's vocabulary
+    _racecheck_exempt = frozenset({"_current"})
 
     def __init__(self, name: str = "default", **runner_opts: Any):
         self.name = name

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
+from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.parallel.collectives import get_link_map
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
@@ -67,6 +68,7 @@ def _worker_event(event: str, rank: int = -1) -> None:
         global_tracer().instant(f"tracker.{event}", rank=rank)
 
 
+@instrument_class
 class RabitTracker:
     """Rank-assignment + topology service over TCP/JSON lines."""
 

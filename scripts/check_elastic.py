@@ -19,8 +19,11 @@ because multiprocess XLA collectives don't exist on the CPU backend):
    ``shard_row_ranges`` re-cuts the rows, and the job converges with
    eval loss within 1% of the baseline.
 
-Every process (parent + workers) runs under ``DMLC_LOCKCHECK=1`` and
-verifies zero lock-order cycles.  Recovery metrics
+Every process (parent + workers) runs under ``DMLC_LOCKCHECK=1`` +
+``DMLC_RACECHECK=1`` and verifies zero lock-order cycles; the parent
+additionally asserts zero happens-before races and archives the
+racecheck report to ``ELASTIC_RACECHECK_OUT`` (default
+``/tmp/elastic_racecheck.json``).  Recovery metrics
 (``dmlc_worker_deaths_total{outcome}``, ``dmlc_elastic_reshards_total``,
 ``dmlc_recovery_floor_round``) are asserted on the tracker registry.
 
@@ -110,6 +113,7 @@ def _launch(port, out_dir, rec_dir, rank=-1, fault=""):
                JAX_PLATFORMS="cpu",
                DMLC_TPU_FORCE_CPU="1",
                DMLC_LOCKCHECK="1",
+               DMLC_RACECHECK="1",
                DMLC_RECOVERY_DIR=rec_dir,
                DMLC_RECOVERY_STRIDE=str(STRIDE),
                DMLC_FAULT_INJECT=fault,
@@ -168,11 +172,12 @@ def main() -> None:
         return
 
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    os.environ.setdefault("DMLC_RACECHECK", "1")
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
-    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.base import lockcheck, racecheck
     from dmlc_core_tpu.base.metrics import default_registry
     from dmlc_core_tpu.parallel.recovery import ElasticTracker
 
@@ -273,6 +278,12 @@ def main() -> None:
 
     lockcheck.check()
     print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    rc_out = os.environ.get("ELASTIC_RACECHECK_OUT",
+                            "/tmp/elastic_racecheck.json")
+    racecheck.write_report(rc_out)
+    racecheck.check()
+    print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
+          f"(parent; report at {rc_out})")
     print("ELASTIC CHAOS DRILL GREEN")
 
 

@@ -19,8 +19,11 @@ closed-loop verified load running THROUGH every incident:
 
 The JSON report (counts, latencies, per-phase verdicts) is archived to
 ``FLEET_OUT`` (default ``/tmp/fleet_drill.json``) for CI artifacts.
-Parent runs under ``DMLC_LOCKCHECK=1`` and verifies zero lock-order
-cycles.  Exit 0 = drill green.  Usage:
+Parent runs under ``DMLC_LOCKCHECK=1`` + ``DMLC_RACECHECK=1`` and
+verifies zero lock-order cycles AND zero happens-before races across
+the whole drill; the racecheck report is archived to
+``FLEET_RACECHECK_OUT`` (default ``/tmp/fleet_racecheck.json``).
+Exit 0 = drill green.  Usage:
     python scripts/check_fleet.py
 """
 
@@ -57,13 +60,14 @@ def _wait(pred, timeout_s, label):
 
 def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    os.environ.setdefault("DMLC_RACECHECK", "1")
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
     import numpy as np
 
-    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.base import lockcheck, racecheck
     from dmlc_core_tpu.models import HistGBT
     from dmlc_core_tpu.serve import checkpoint_model
     from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
@@ -89,7 +93,7 @@ def main() -> None:
     np.savez(expected_npz, X=X, v1=m1.predict(X), v2=m2.predict(X))
 
     child_env = {"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
-                 "DMLC_LOCKCHECK": "1"}
+                 "DMLC_LOCKCHECK": "1", "DMLC_RACECHECK": "1"}
     tracker = FleetTracker(nworker=8)
     tracker.start()
     procs = [spawn_replica("127.0.0.1", tracker.port, model_uri=v1_uri,
@@ -217,6 +221,12 @@ def main() -> None:
     print(f"   report archived to {out_path}")
     lockcheck.check()
     print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    rc_out = os.environ.get("FLEET_RACECHECK_OUT",
+                            "/tmp/fleet_racecheck.json")
+    racecheck.write_report(rc_out)
+    racecheck.check()
+    print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
+          f"(parent; report at {rc_out})")
     print("FLEET CHAOS DRILL GREEN")
 
 

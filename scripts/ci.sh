@@ -32,6 +32,16 @@ if (( SECONDS - t0 > 10 )); then
     exit 1
 fi
 
+echo "== interleave model check (schedule exploration) =="
+# cooperative-scheduler model checker (analysis/interleave): proves the
+# four serving-stack concurrency invariants — circuit-breaker single
+# probe, rollout state machine, batcher flush/drain, registry hot-swap
+# — over DMLC_INTERLEAVE_SCHEDULES (default 200) DISTINCT schedules
+# each, mixing bounded-exhaustive DFS with seeded random walks.  Runs
+# in BOTH lanes (quick included) — pure CPU, seconds, no devices.
+env JAX_PLATFORMS=cpu DMLC_TPU_FORCE_CPU=1 \
+    python -m dmlc_core_tpu.analysis.interleave
+
 echo "== api docs =="
 # regenerate doc/api/ + doc/configuration.md (knob table from
 # base/knobs.py) and FAIL on undocumented __all__ exports (SURVEY.md
@@ -99,9 +109,13 @@ echo "== elastic recovery chaos drill (die / rejoin / catch-up + evict) =="
 # rejoin path must reproduce the uninterrupted run's save_model bytes
 # exactly (recovery floor + deterministic fold); the elastic-evict path
 # re-shards onto the survivors and must converge within 1% eval loss.
-# Every process runs under DMLC_LOCKCHECK=1 with zero order cycles
-# (doc/robustness.md "Distributed recovery").
-env JAX_PLATFORMS=cpu python scripts/check_elastic.py
+# Every process runs under DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1 with
+# zero order cycles and zero happens-before races; the racecheck JSON
+# is archived like the drill report (doc/robustness.md "Distributed
+# recovery").
+env JAX_PLATFORMS=cpu \
+    ELASTIC_RACECHECK_OUT="${ELASTIC_RACECHECK_OUT:-/tmp/elastic_racecheck.json}" \
+    python scripts/check_elastic.py
 
 echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
 # 3 subprocess replicas behind the consistent-hash router with verified
@@ -110,9 +124,13 @@ echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
 # wrong), the local autoscale backend respawns it, then a staged v1->v2
 # rollout under load must keep per-replica versions monotone and land
 # the whole fleet on v2 — still zero dropped / zero wrong.  The JSON
-# report is archived; parent runs under DMLC_LOCKCHECK=1 with zero
-# order cycles (doc/serving.md "Fleet serving").
-env JAX_PLATFORMS=cpu python scripts/check_fleet.py
+# report is archived; parent runs under DMLC_LOCKCHECK=1 +
+# DMLC_RACECHECK=1 with zero order cycles and zero happens-before
+# races, and the racecheck JSON is archived alongside
+# (doc/serving.md "Fleet serving").
+env JAX_PLATFORMS=cpu \
+    FLEET_RACECHECK_OUT="${FLEET_RACECHECK_OUT:-/tmp/fleet_racecheck.json}" \
+    python scripts/check_fleet.py
 
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="

@@ -8,6 +8,11 @@ grammar and baseline workflow):
   folded into the shared walker;
 * ``lock-discipline`` / ``lock-release`` — shared mutable state outside
   ``with self._lock``, and ``acquire()`` without try/finally;
+* ``lock-blocking`` — blocking calls (sleep / socket / HTTP /
+  subprocess / untimed wait / join / untimed queue op) while a lock is
+  held;
+* ``atomicity`` — unlocked read-modify-write / check-then-act on
+  attributes the class locks elsewhere;
 * ``jit-purity`` — env/clock/RNG/metrics/closure-mutation inside
   jit-traced functions;
 * ``knob-registry`` / ``knob-doc`` — every ``DMLC_*`` literal declared
@@ -19,10 +24,14 @@ Usage:
     python scripts/dmlcheck.py                     # full run, baseline applied
     python scripts/dmlcheck.py --rules style,jit-purity
     python scripts/dmlcheck.py --json /tmp/dmlcheck.json
+    python scripts/dmlcheck.py --explain atomicity # pass doc + examples
+    python scripts/dmlcheck.py --timings           # per-pass seconds
     python scripts/dmlcheck.py --write-baseline    # grandfather current findings
     python scripts/dmlcheck.py --no-baseline       # show baselined findings too
 
-Exit code 0 = no non-baselined findings; 1 otherwise.
+Exit code 0 = no non-baselined findings AND no stale baseline entries;
+1 otherwise (a stale entry means the finding was fixed — remove it so
+the baseline shrinks monotonically).
 """
 
 from __future__ import annotations
@@ -37,10 +46,31 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 from dmlc_core_tpu.analysis import (  # noqa: E402
-    ALL_RULES, analyze, load_baseline, write_baseline,
+    ALL_RULES, analyze, load_baseline, rule_help, write_baseline,
 )
 
 DEFAULT_BASELINE = os.path.join(ROOT, "scripts", "dmlcheck_baseline.json")
+
+
+def _explain(rule: str) -> int:
+    try:
+        info = rule_help(rule)
+    except ValueError as e:
+        print(f"dmlcheck: {e} (known: {', '.join(ALL_RULES)})",
+              file=sys.stderr)
+        return 2
+    print(f"[{info['rule']}]  (pass module: {info['module']})")
+    print()
+    print(info["doc"])
+    if info.get("flagged"):
+        print("\nflagged:\n")
+        for line in info["flagged"].rstrip().splitlines():
+            print(f"    {line}")
+    if info.get("clean"):
+        print("\nclean:\n")
+        for line in info["clean"].rstrip().splitlines():
+            print(f"    {line}")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -48,6 +78,13 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset "
                          f"(default: all of {', '.join(ALL_RULES)})")
+    ap.add_argument("--explain", default=None, metavar="RULE",
+                    help="print RULE's pass doc plus a minimal "
+                         "flagged/clean example pair, then exit")
+    ap.add_argument("--timings", action="store_true",
+                    help="print per-pass seconds (always included in "
+                         "--json) so the 10s CI budget stays "
+                         "attributable")
     ap.add_argument("--json", dest="json_out", default=None, metavar="PATH",
                     help="write the machine-readable report here "
                          "(archived by CI like bench metrics)")
@@ -61,6 +98,9 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--root", default=ROOT, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.explain:
+        return _explain(args.explain)
 
     rules = args.rules.split(",") if args.rules else None
     t0 = time.perf_counter()
@@ -82,15 +122,27 @@ def main(argv=None) -> int:
     for f in live:
         print(f.render())
     if stale:
-        print(f"dmlcheck: note: {len(stale)} stale baseline entr"
-              f"{'y' if len(stale) == 1 else 'ies'} (fixed findings — "
-              "shrink the baseline):", file=sys.stderr)
+        # a stale fingerprint means its finding was FIXED: failing here
+        # (not merely noting) is what makes the baseline shrink
+        # monotonically instead of fossilizing
+        print(f"dmlcheck: FAIL: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} no longer match any "
+              "finding — remove me from "
+              f"{os.path.relpath(args.baseline, args.root)}:",
+              file=sys.stderr)
         for fp in sorted(stale):
-            print(f"  - {fp}", file=sys.stderr)
+            print(f"  - remove me: {fp}", file=sys.stderr)
     print(f"dmlcheck: {len(ctx.files)} files, "
           f"{len(live)} finding(s), {grandfathered} baselined, "
           f"{ctx.suppressed_count} suppressed, {elapsed:.2f}s",
           file=sys.stderr)
+    if args.timings:
+        order = sorted(ctx.pass_seconds, key=ctx.pass_seconds.get,
+                       reverse=True)
+        print("dmlcheck: per-pass timings: "
+              + ", ".join(f"{n} {ctx.pass_seconds[n]:.2f}s"
+                          for n in order),
+              file=sys.stderr)
 
     if args.json_out:
         report = {
@@ -105,6 +157,8 @@ def main(argv=None) -> int:
             ],
             "suppressed": ctx.suppressed_count,
             "stale_baseline": sorted(stale),
+            "pass_seconds": {k: round(v, 4)
+                             for k, v in ctx.pass_seconds.items()},
         }
         d = os.path.dirname(os.path.abspath(args.json_out))
         if d:
@@ -112,7 +166,7 @@ def main(argv=None) -> int:
         with open(args.json_out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1)
         print(f"dmlcheck: report -> {args.json_out}", file=sys.stderr)
-    return 1 if live else 0
+    return 1 if (live or stale) else 0
 
 
 if __name__ == "__main__":

@@ -489,3 +489,226 @@ def test_lockcheck_env_gate(monkeypatch):
     assert lockcheck.env_enabled()
     monkeypatch.setenv("DMLC_LOCKCHECK", "0")
     assert not lockcheck.env_enabled()
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking (ISSUE 11): blocking calls while a lock is held
+# ---------------------------------------------------------------------------
+
+_BLOCKING_BAD = """
+    import threading
+    import time
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done = threading.Event()
+            self._jobs = []
+
+        def step(self):
+            with self._lock:
+                time.sleep(1.0)         # world stops with you
+
+        def push_locked(self, sock):
+            data = sock.recv(4096)      # network time under the lock
+            self._jobs.append(data)
+
+        def drain(self, work_queue):
+            with self._lock:
+                return work_queue.get()     # untimed queue op
+
+        def settle(self):
+            with self._lock:
+                self._done.wait()       # Event.wait releases NOTHING
+"""
+
+_BLOCKING_GOOD = """
+    import threading
+    import time
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._jobs = []
+
+        def step(self):
+            with self._lock:
+                jobs = list(self._jobs)
+            time.sleep(0.1)             # sleep OUTSIDE the lock
+            return jobs
+
+        def wait_ready(self):
+            with self._cv:
+                self._cv.wait()         # own condvar: releases monitor
+
+        def bounded(self, work_queue, ev):
+            with self._lock:
+                item = work_queue.get(timeout=1.0)   # bounded
+                ev.wait(0.5)                         # bounded
+                return item
+"""
+
+
+def test_lock_blocking_flags_sleep_socket_queue_wait(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _BLOCKING_BAD}),
+                  rules=["lock-blocking"])
+    got = _findings(ctx, "lock-blocking")
+    whats = sorted(f.key.split(":")[-1] for f in got)
+    assert whats == ["queue.get", "socket.recv", "time.sleep", "wait"]
+
+
+def test_lock_blocking_clean_patterns(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _BLOCKING_GOOD}),
+                  rules=["lock-blocking"])
+    assert _findings(ctx, "lock-blocking") == []
+
+
+def test_lock_blocking_skips_lockless_classes(tmp_path):
+    src = """
+        import time
+
+        class Free:
+            def nap(self):
+                time.sleep(1.0)     # no lock attrs -> out of scope
+    """
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["lock-blocking"])
+    assert _findings(ctx, "lock-blocking") == []
+
+
+# ---------------------------------------------------------------------------
+# atomicity (ISSUE 11): unlocked compounds on mixed-locking attributes
+# ---------------------------------------------------------------------------
+
+_ATOMICITY_BAD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._open = False
+
+        def snapshot(self):
+            with self._lock:
+                return self._n, self._open
+
+        def bump(self):
+            self._n += 1            # unlocked RMW: updates lost
+
+        def close_once(self):
+            if self._open:
+                self._open = False  # unlocked check-then-act
+"""
+
+_ATOMICITY_GOOD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._hits = 0          # never locked -> lock-free by design
+
+        def snapshot(self):
+            with self._lock:
+                return self._n
+
+        def bump(self):
+            with self._lock:
+                self._n += 1        # compound under the lock
+
+        def hit(self):
+            self._hits += 1
+
+        def _drain_locked(self):
+            self._n += 1            # *_locked: caller holds the lock
+"""
+
+
+def test_atomicity_flags_unlocked_rmw_and_check_then_act(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _ATOMICITY_BAD}),
+                  rules=["atomicity"])
+    got = _findings(ctx, "atomicity")
+    kinds = sorted((f.key.split(":")[0], f.key.split(":")[-1])
+                   for f in got)
+    assert kinds == [("Counter._n", "rmw"),
+                     ("Counter._open", "check-then-act")]
+    assert all("not atomic" in f.message for f in got)
+
+
+def test_atomicity_clean_locked_compounds_and_lockfree_attrs(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _ATOMICITY_GOOD}),
+                  rules=["atomicity"])
+    assert _findings(ctx, "atomicity") == []
+
+
+def test_atomicity_suppression(tmp_path):
+    src = _ATOMICITY_BAD.replace(
+        "self._n += 1            # unlocked RMW: updates lost",
+        "self._n += 1  # dmlcheck: off:atomicity")
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["atomicity"])
+    assert len(_findings(ctx, "atomicity")) == 1    # the other one
+    assert ctx.suppressed_count == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --explain, stale-baseline FAIL, per-pass timings
+# ---------------------------------------------------------------------------
+
+def test_rule_help_has_doc_and_example_pair():
+    from dmlc_core_tpu.analysis import rule_help
+
+    for rule in ("lock-blocking", "atomicity"):
+        info = rule_help(rule)
+        assert info["rule"] == rule
+        assert info["doc"] and info["flagged"] and info["clean"]
+    with pytest.raises(ValueError, match="unknown dmlcheck rule"):
+        rule_help("not-a-rule")
+
+
+def _run_cli(args):
+    import subprocess
+    import sys as _sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [_sys.executable, os.path.join(root, "scripts", "dmlcheck.py"),
+         *args], capture_output=True, text=True)
+
+
+def test_cli_explain_prints_pass_doc():
+    r = _run_cli(["--explain", "atomicity"])
+    assert r.returncode == 0
+    assert "[atomicity]" in r.stdout
+    assert "flagged:" in r.stdout and "clean:" in r.stdout
+    r2 = _run_cli(["--explain", "nope"])
+    assert r2.returncode == 2
+    assert "unknown dmlcheck rule" in r2.stderr
+
+
+def test_cli_stale_baseline_entry_fails_with_remove_me(tmp_path):
+    import json as _json
+
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": "x = 1\n"})
+    bp = tmp_path / "baseline.json"
+    bp.write_text(_json.dumps(
+        {"findings": ["dmlc_core_tpu/gone.py::atomicity::X._n:bump:rmw"]}))
+    r = _run_cli(["--root", root, "--baseline", str(bp)])
+    assert r.returncode == 1
+    assert "stale baseline" in r.stderr and "remove me" in r.stderr
+
+
+def test_cli_timings_reports_new_passes(tmp_path):
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": "x = 1\n"})
+    bp = tmp_path / "baseline.json"
+    r = _run_cli(["--root", root, "--baseline", str(bp), "--timings"])
+    assert r.returncode == 0
+    assert "per-pass timings" in r.stderr
+    assert "blocking" in r.stderr and "atomicity" in r.stderr

@@ -1,0 +1,199 @@
+"""interleave (schedule-exploration model checker) contracts.
+
+Two layers of trust: the SCHEDULER itself must be deterministic,
+replayable and deadlock-aware (else "explored N schedules" means
+nothing), and the four built-in models must both PASS on today's code
+and FAIL when the code is deliberately re-broken — the re-broken
+CircuitBreaker probe race (PR 5's bug, reintroduced via monkeypatch)
+is the canary proving the explorer actually reaches the interleavings
+that matter.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import pytest
+
+from dmlc_core_tpu.analysis import interleave as ilv
+from dmlc_core_tpu.base.logging import _logger as _dmlc_logger
+
+
+@pytest.fixture(autouse=True)
+def _quiet_models():
+    """Hundreds of runs per test: breaker OPEN warnings and registry
+    publish INFO lines would drown the report."""
+    before = _dmlc_logger.level
+    _dmlc_logger.setLevel(logging.ERROR)
+    yield
+    _dmlc_logger.setLevel(before)
+
+
+# ---------------------------------------------------------------------------
+# the scheduler itself
+# ---------------------------------------------------------------------------
+
+def _two_incrementers(locked):
+    """Model: two tasks increment a shared counter; with the lock the
+    invariant holds on EVERY schedule, without it some schedule loses
+    an update."""
+    def model(sched):
+        lock = ilv.CoopLock(sched)
+        box = {"n": 0}
+
+        def bump():
+            if locked:
+                with lock:
+                    v = box["n"]
+                    sched.point()       # the racy window, made explicit
+                    box["n"] = v + 1
+            else:
+                v = box["n"]
+                sched.point()
+                box["n"] = v + 1
+
+        sched.spawn(bump)
+        sched.spawn(bump)
+        sched.go()
+        assert box["n"] == 2, f"lost update: {box['n']}"
+    return model
+
+
+def test_locked_increment_holds_on_every_schedule():
+    r = ilv.explore(_two_incrementers(locked=True), schedules=64,
+                    mode="dfs")
+    assert r.failures == [] and r.runs >= 1
+
+
+def test_unlocked_increment_fails_some_schedule():
+    r = ilv.explore(_two_incrementers(locked=False), schedules=64,
+                    mode="dfs")
+    assert r.failures, "explorer missed the seeded lost-update"
+    with pytest.raises(ilv.InvariantViolation) as ei:
+        ilv.verify(_two_incrementers(locked=False), schedules=64,
+                   mode="dfs")
+    assert ei.value.trace    # the failing schedule is replayable
+
+
+def test_replay_is_deterministic():
+    model = _two_incrementers(locked=False)
+    r = ilv.explore(model, schedules=64, mode="dfs")
+    trace = r.failures[0]["trace"]
+    # re-running under the exact failing trace reproduces the failure
+    _, _, err = ilv._run_once(
+        model, ilv._replay_pick(tuple(trace)), max_steps=20000)
+    assert isinstance(err, AssertionError)
+
+
+def test_dfs_exhausts_a_small_tree():
+    def model(sched):
+        a = sched.choose(2)
+        b = sched.choose(3)
+        assert (a, b) is not None
+
+    r = ilv.explore(model, schedules=50, mode="dfs")
+    assert r.exhausted and r.distinct == 6      # 2 * 3 leaves
+
+
+def test_deadlock_is_a_finding_not_a_hang():
+    def model(sched):
+        l1, l2 = ilv.CoopLock(sched), ilv.CoopLock(sched)
+
+        def ab():
+            with l1:
+                sched.point()
+                with l2:
+                    pass
+
+        def ba():
+            with l2:
+                sched.point()
+                with l1:
+                    pass
+
+        sched.spawn(ab)
+        sched.spawn(ba)
+        sched.go()
+
+    r = ilv.explore(model, schedules=64, mode="dfs")
+    assert any(isinstance(f["error"], ilv.Deadlock) for f in r.failures)
+
+
+def test_schedule_limit_stops_runaway_models():
+    def model(sched):
+        while True:
+            sched.choose(2)
+
+    _, _, err = ilv._run_once(
+        model, lambda step, n: 0, max_steps=50)
+    assert isinstance(err, ilv.ScheduleLimit)
+
+
+def test_logical_time_fires_timeouts_deterministically():
+    def model(sched):
+        ev = ilv.CoopEvent(sched)
+        outcomes = []
+
+        def waiter():
+            outcomes.append(ev.wait(timeout=0.5))
+
+        sched.spawn(waiter)
+        sched.go()
+        assert outcomes == [False]      # timed out at logical t=0.5
+        assert sched.now >= 0.5
+
+    r = ilv.explore(model, schedules=8, mode="dfs")
+    assert r.failures == []
+
+
+def test_env_schedules_default_and_override(monkeypatch):
+    monkeypatch.delenv("DMLC_INTERLEAVE_SCHEDULES", raising=False)
+    assert ilv.env_schedules() == 200
+    monkeypatch.setenv("DMLC_INTERLEAVE_SCHEDULES", "37")
+    assert ilv.env_schedules() == 37
+
+
+# ---------------------------------------------------------------------------
+# the four built-in models: pass today, >= 200 distinct schedules each
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(ilv.builtin_models()))
+def test_builtin_model_proves_invariant_over_200_schedules(name):
+    r = ilv.explore(ilv.builtin_models()[name], schedules=200,
+                    mode="mixed")
+    assert r.failures == [], (
+        f"{name}: {len(r.failures)} schedule(s) violate the invariant; "
+        f"first: {r.failures[0]['error']!r} "
+        f"trace={r.failures[0]['trace']}" if r.failures else "")
+    assert r.exhausted or r.distinct >= 200, (
+        f"{name}: only {r.distinct} distinct schedules explored")
+
+
+# ---------------------------------------------------------------------------
+# the canary: re-break PR 5's CircuitBreaker probe race, expect failures
+# ---------------------------------------------------------------------------
+
+def test_rebroken_circuit_breaker_race_is_caught(monkeypatch):
+    """Reintroduce the unlocked ``_probing`` check-then-act that PR 5
+    fixed; the explorer MUST find a schedule admitting two probes."""
+    from dmlc_core_tpu.base.resilience import CircuitBreaker
+
+    def broken_allow(self):
+        with self._lock:
+            self._maybe_half_open_locked()
+            state = self._state
+        if state == CircuitBreaker.CLOSED:
+            return True
+        if state == CircuitBreaker.OPEN:
+            return False
+        if self._probing:           # check ... [preemption window] ...
+            return False
+        self._probing = True        # ... act: two probers both pass
+        return True
+
+    monkeypatch.setattr(CircuitBreaker, "allow", broken_allow)
+    r = ilv.explore(ilv.model_circuit_breaker, schedules=200,
+                    mode="mixed")
+    assert r.failures, (
+        "explorer failed to catch the re-broken single-probe invariant")
+    assert any("probes" in str(f["error"]) for f in r.failures)

@@ -1,0 +1,227 @@
+"""racecheck (vector-clock happens-before race detector) contracts.
+
+The dynamic third layer of the concurrency suite (ISSUE 11): a seeded
+unlocked write/read pair MUST be reported (with both stacks), and each
+edge of the traced-sync vocabulary — lock pairs, Event set→wait,
+Thread fork/join, ConcurrentBlockingQueue handoffs — MUST silence the
+same access pattern.  False negatives here mean the drills' zero-race
+assertions are vacuous; false positives would make them flaky.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu.base import racecheck
+from dmlc_core_tpu.io.concurrency import ConcurrentBlockingQueue
+
+
+@racecheck.instrument_class
+class _Shared:
+    """Minimal opt-in class: one `_x` slot in the instance dict."""
+
+    _racecheck_exempt = frozenset({"_exempted"})
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0
+        self._exempted = 0
+
+
+@pytest.fixture
+def rc():
+    installed_before = racecheck.installed()
+    if not installed_before:
+        racecheck.install()
+    racecheck.reset()
+    yield racecheck
+    racecheck.reset()
+    if not installed_before:
+        racecheck.uninstall()
+
+
+def _run_threads(*fns):
+    threads = [threading.Thread(target=fn) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# the positive case: an unlocked cross-thread pair IS a race
+# ---------------------------------------------------------------------------
+
+def test_unlocked_write_read_is_reported_with_both_stacks(rc):
+    obj = _Shared()
+
+    def writer():
+        obj._x = 1
+
+    def reader():
+        time.sleep(0.05)        # a sleep is NOT a happens-before edge
+        _ = obj._x
+
+    _run_threads(writer, reader)
+    got = rc.races()
+    assert got, "seeded race not detected"
+    r = got[0]
+    assert r["class"] == "_Shared" and r["attr"] == "_x"
+    assert r["kind"] in ("write-read", "read-write", "write-write")
+    # both halves carry a repo-relative stack naming this test file
+    for half in ("prior", "current"):
+        assert "test_racecheck.py" in r[half]["stack"]
+        assert r[half]["thread"] > 0
+    assert r["prior"]["thread"] != r["current"]["thread"]
+    with pytest.raises(racecheck.RaceError, match="_Shared._x"):
+        rc.check()
+
+
+def test_exempt_attr_is_not_tracked(rc):
+    obj = _Shared()
+
+    def writer():
+        obj._exempted = 1
+
+    def reader():
+        time.sleep(0.05)
+        _ = obj._exempted
+
+    _run_threads(writer, reader)
+    assert rc.races() == []
+
+
+# ---------------------------------------------------------------------------
+# each traced-sync edge silences the same pattern
+# ---------------------------------------------------------------------------
+
+def test_lock_pair_orders_accesses(rc):
+    obj = _Shared()
+
+    def bump():
+        for _ in range(50):
+            with obj._lock:
+                obj._x += 1
+
+    _run_threads(bump, bump)
+    assert rc.races() == []
+    with obj._lock:
+        assert obj._x == 100
+    rc.check()      # must not raise
+
+
+def test_event_set_wait_is_an_hb_edge(rc):
+    obj = _Shared()
+    ready = threading.Event()
+
+    def writer():
+        obj._x = 7
+        ready.set()             # publishes the writer's clock
+
+    def reader():
+        assert ready.wait(timeout=30)
+        assert obj._x == 7      # joined the clock: ordered, no race
+
+    _run_threads(writer, reader)
+    assert rc.races() == []
+
+
+def test_thread_fork_and_join_edges(rc):
+    obj = _Shared()
+    obj._x = 10                 # parent write BEFORE start: fork edge
+
+    def child():
+        assert obj._x == 10
+        obj._x = 11
+
+    t = threading.Thread(target=child)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert obj._x == 11         # parent read AFTER join: join edge
+    assert rc.races() == []
+
+
+def test_queue_handoff_orders_producer_and_consumer(rc):
+    obj = _Shared()
+    q: ConcurrentBlockingQueue[int] = ConcurrentBlockingQueue(max_size=4)
+    got = []
+
+    def producer():
+        for i in range(100):
+            obj._x = i          # write, then hand off through the queue
+            q.push(i)
+
+    def consumer():
+        for _ in range(100):
+            got.append(q.pop(timeout=30))
+            _ = obj._x          # ordered by the queue's monitor
+
+    _run_threads(producer, consumer)
+    assert got == list(range(100))
+    assert [r for r in rc.races() if r["attr"] == "_x"] == []
+
+
+# ---------------------------------------------------------------------------
+# reporting surface
+# ---------------------------------------------------------------------------
+
+def test_write_report_schema(rc, tmp_path):
+    obj = _Shared()
+
+    def writer():
+        obj._x = 1
+
+    def reader():
+        time.sleep(0.05)
+        _ = obj._x
+
+    _run_threads(writer, reader)
+    path = tmp_path / "racecheck.json"
+    report = rc.write_report(str(path))
+    assert report["enabled"] is True
+    assert report["tracked_accesses"] > 0
+    assert "_Shared" in report["instrumented_classes"]
+    assert report["races"]
+    on_disk = json.loads(path.read_text())
+    assert on_disk["races"] == report["races"]
+
+
+def test_reset_clears_history(rc):
+    obj = _Shared()
+
+    def writer():
+        obj._x = 1
+
+    def reader():
+        time.sleep(0.05)
+        _ = obj._x
+
+    _run_threads(writer, reader)
+    assert rc.races()
+    rc.reset()
+    assert rc.races() == []
+    rc.check()      # clean slate
+
+
+def test_env_gate(monkeypatch):
+    monkeypatch.setenv("DMLC_RACECHECK", "1")
+    assert racecheck.env_enabled()
+    monkeypatch.setenv("DMLC_RACECHECK", "0")
+    assert not racecheck.env_enabled()
+
+
+def test_disabled_by_default_costs_nothing():
+    """Without install(), instrumented classes run on the ORIGINAL
+    attribute protocol (no wrappers applied)."""
+    if racecheck.installed():
+        pytest.skip("racecheck force-installed for this session")
+    obj = _Shared()
+    obj._x = 5
+    assert obj._x == 5
+    assert type(obj).__getattribute__ is object.__getattribute__

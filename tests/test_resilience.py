@@ -514,8 +514,13 @@ def test_chaos_soak_train_and_serve():
     during the run joins the cross-thread order graph, and the run must
     finish with ZERO cycles.  ``DMLC_LOCKCHECK=1`` pre-installs the
     verifier at import and widens coverage to import-time singletons;
-    otherwise it is installed here for the soak's duration."""
-    from dmlc_core_tpu.base import lockcheck
+    otherwise it is installed here for the soak's duration.
+
+    The happens-before race detector (``base/racecheck``) rides the
+    same workload: registry hot-swap state, batcher queue handoffs and
+    client threads all cross under faults, and the run must finish with
+    ZERO unordered shared-attribute access pairs."""
+    from dmlc_core_tpu.base import lockcheck, racecheck
     from dmlc_core_tpu.models.histgbt import HistGBT
     from dmlc_core_tpu.serve import ModelRegistry, ResilientClient, \
         ServeFrontend
@@ -523,6 +528,9 @@ def test_chaos_soak_train_and_serve():
     we_installed = not lockcheck.installed()
     if we_installed:
         lockcheck.install()
+    rc_installed = not racecheck.installed()
+    if rc_installed:
+        racecheck.install()
 
     rng = np.random.default_rng(0)
     X = rng.standard_normal((512, 8)).astype(np.float32)
@@ -569,10 +577,15 @@ def test_chaos_soak_train_and_serve():
                 t.join()
             faults = fi.fired_total()
 
+    race_list = racecheck.races()
+    if rc_installed:
+        racecheck.uninstall()
     if we_installed:
         lockcheck.uninstall()
     assert lockcheck.violations() == [], (
         f"lock-order cycles under chaos: {lockcheck.violations()}")
+    assert race_list == [], (
+        f"happens-before races under chaos: {race_list}")
     assert wrong == [], f"wrong answers under chaos: {wrong}"
     assert faults > 0, "chaos soak injected nothing"
     assert answered[0] > 0, "every request shed — retry layer is dead"
