@@ -50,6 +50,7 @@ from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 from dmlc_core_tpu.data.device_feed import assemble_row_sharded
 from dmlc_core_tpu.data.iter import slab_shard_slices
+from dmlc_core_tpu.ops import binlayout as _bl
 from dmlc_core_tpu.ops.histogram import (build_histogram,
                                          fused_descend_histogram,
                                          hist_psum_bytes_per_round,
@@ -143,6 +144,46 @@ def _hist_blocks(data_size: int) -> int:
     return c
 
 
+def _grow_policy() -> str:
+    """Tree growth policy (``DMLC_GROW_POLICY``): ``depthwise`` (default,
+    the bit-parity-pinned complete-tree engine) or ``lossguide``
+    (LightGBM-style leaf-wise growth: expand the open leaf with the best
+    gain, building ONE histogram per expansion + sibling subtraction)."""
+    v = os.environ.get("DMLC_GROW_POLICY", "depthwise")
+    CHECK(v in ("depthwise", "lossguide"),
+          f"DMLC_GROW_POLICY must be 'depthwise' or 'lossguide', got {v!r}")
+    return v
+
+
+def _max_leaves() -> int:
+    """``DMLC_MAX_LEAVES``: leaf budget for lossguide growth (0 = full
+    2^max_depth, i.e. no budget beyond the depth cap)."""
+    return get_env("DMLC_MAX_LEAVES", 0, int)
+
+
+def _bin_pack_requested() -> bool:
+    """``DMLC_BIN_PACK=1``: pack two ≤16-bin features per byte (int4) in
+    the transposed bin matrix (ops.binlayout), halving HBM bin traffic
+    and psum bytes for narrow features.  Bit-identical histograms."""
+    return os.environ.get("DMLC_BIN_PACK", "0") == "1"
+
+
+def _feature_bundle_requested() -> bool:
+    """``DMLC_FEATURE_BUNDLE=1``: fuse mutually-exclusive (near-one-hot)
+    feature blocks into one multi-bin storage feature (EFB), with exact
+    unbundling at split evaluation (ops.binlayout.detect_bundles)."""
+    return os.environ.get("DMLC_FEATURE_BUNDLE", "0") == "1"
+
+
+@lru_cache(maxsize=32)
+def _pack_matrix_fn(mesh: Mesh, layout: "_bl.BinLayout"):
+    """Jitted bin-matrix packing for one (mesh, layout): [F, n] uint8 →
+    [phys_rows, n] with nibble pairs and bundles encoded; rows stay
+    sharded P(None, "data") so the pack is shard-local."""
+    return jax.jit(lambda bt: _bl.pack_matrix(bt, layout),
+                   out_shardings=NamedSharding(mesh, P(None, "data")))
+
+
 def _tree_fold(parts):
     """Fixed-order pairwise fold of a power-of-two list of arrays — the
     one reduction tree every mesh shape shares (see :func:`_hist_blocks`).
@@ -233,8 +274,13 @@ class _RoundProgramWarmup:
         row = NamedSharding(mesh, P("data"))
         margin = (NamedSharding(mesh, P("data", None))
                   if p.num_class > 1 else row)
+        # packed/bundled layouts change the PHYSICAL bin-matrix height;
+        # the layout is part of the cache key so a mismatch between what
+        # was warmed and what fit dispatches is caught by key equality
+        lay = model._bin_layout
+        mat_rows = lay.phys_rows if lay is not None else n_features
         args = [
-            jax.ShapeDtypeStruct((n_features, n_padded), np.uint8,
+            jax.ShapeDtypeStruct((mat_rows, n_padded), np.uint8,
                                  sharding=mat),
             jax.ShapeDtypeStruct((n_padded,), np.float32, sharding=row),
             jax.ShapeDtypeStruct((n_padded,), np.float32, sharding=row),
@@ -445,6 +491,11 @@ class HistGBT(_ExternalMemoryEngine):
         self.last_warm_dispatch_seconds: Optional[float] = None
         self.last_compile_cache: Optional[str] = None
         self._pending_warmup: Optional[_RoundProgramWarmup] = None
+        #: active packed/bundled bin layout (ops.binlayout.BinLayout) of
+        #: the device-resident bin matrix, or None for the plain uint8
+        #: [F, n] layout.  Set by make_device_data, consumed by
+        #: _build_round_fn (part of the round-program cache key).
+        self._bin_layout: Optional[_bl.BinLayout] = None
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
         self._early_stopped = False
@@ -548,6 +599,10 @@ class HistGBT(_ExternalMemoryEngine):
             else:
                 bins = self._bin_matrix(jax.device_put(X, mat_sharding))
             bins_t = _transpose_to_feature_major_fn(self.mesh)(bins)
+            # the continue branch builds a plain [F, n] matrix — a packed
+            # layout left over from an earlier make_device_data must not
+            # leak into this fit's round program
+            self._bin_layout = None
             y_d = jax.device_put(y, row_sharding)
             w_d = jax.device_put(mask, row_sharding)
             margin_shape = self._margin_shape(n + n_pad)
@@ -807,7 +862,9 @@ class HistGBT(_ExternalMemoryEngine):
         # bench.py's hist_psum_bytes_per_round shares)
         dsize = int(self.mesh.shape["data"])
         psum_round_bytes = (hist_psum_bytes_per_round(
-            p.max_depth, n_features, p.n_bins) * max(p.num_class, 1)
+            p.max_depth, n_features, p.n_bins,
+            layout=self._bin_layout, grow_policy=_grow_policy(),
+            max_leaves=_max_leaves()) * max(p.num_class, 1)
             if dsize > 1 else 0)
 
         t0 = get_time()
@@ -974,6 +1031,14 @@ class HistGBT(_ExternalMemoryEngine):
         from dmlc_core_tpu.parallel import collectives as coll
         if coll.world_size() > 1 or self._mesh_spans_processes():
             return None
+        if self._pending_warmup is not None:
+            # a matching handle is already in flight (bench kicks one off
+            # before datagen; make_device_data must not duplicate the
+            # compile work) — keep it; replace only on a real mismatch
+            K, rem = _rounds_schedule(self.param.n_trees, eval_every)
+            if self._pending_warmup.matches(self._round_fn_cache_key,
+                                            n_features, n_padded, K, rem):
+                return self._pending_warmup
         try:
             warm = _RoundProgramWarmup(self, n_features, n_padded,
                                        eval_every)
@@ -983,6 +1048,23 @@ class HistGBT(_ExternalMemoryEngine):
             return None
         self._pending_warmup = warm
         return warm
+
+    def start_warmup(self, n_rows: int, n_features: int) -> bool:
+        """Kick the round-program compiles in the background BEFORE the
+        training data exists (the bench cold-start overlap: compile
+        proceeds while datagen/ingest run).  Rows are padded exactly as
+        ``make_device_data`` will pad them, so the handle this parks is
+        the one ``fit_device`` later joins — the dedup guard in
+        ``_maybe_start_warmup`` makes the ingest-time kick a no-op.
+
+        Returns False without compiling when a packed bin layout is
+        requested (``DMLC_BIN_PACK``/``DMLC_FEATURE_BUNDLE``): the
+        layout is a compile-time constant derived from the binned data,
+        so the compile cannot start before ingest."""
+        if _bin_pack_requested() or _feature_bundle_requested():
+            return False
+        n_padded = n_rows + ((-n_rows) % self._pad_multiple())
+        return self._maybe_start_warmup(n_features, n_padded) is not None
 
     def _bin_ingest_streamed(self, X: np.ndarray,
                              mat_sharding: NamedSharding) -> jax.Array:
@@ -1382,8 +1464,13 @@ class HistGBT(_ExternalMemoryEngine):
         # every compile-time constant of the round program is now
         # pinned (cuts mode, shapes, params) — start compiling it in
         # the background so XLA works while the binning + H2D staging
-        # below runs (the cold-start overlap; _boost_binned joins)
-        self._maybe_start_warmup(F, n + ((-n) % self._pad_multiple()))
+        # below runs (the cold-start overlap; _boost_binned joins).
+        # With packing/bundling requested the layout (a compile-time
+        # constant) is only known AFTER ingest, so the kick moves there.
+        pack_wanted = ((_bin_pack_requested() or _feature_bundle_requested())
+                       and not self._missing)
+        if not pack_wanted:
+            self._maybe_start_warmup(F, n + ((-n) % self._pad_multiple()))
         X, y, mask, n_pad = self._pad_rows(X, y, weight)
 
         row_sharding = NamedSharding(self.mesh, P("data"))
@@ -1428,6 +1515,27 @@ class HistGBT(_ExternalMemoryEngine):
             # path so the full f32 matrix is never device-resident next
             # to its uint8 bins (see _bin_ingest_streamed).
             bins_t = self._bin_ingest_streamed(X, mat_sharding)
+        layout = None
+        if pack_wanted:
+            from dmlc_core_tpu.parallel import collectives as coll2
+            if coll2.world_size() > 1 or self._mesh_spans_processes():
+                LOG("WARNING", "DMLC_BIN_PACK/DMLC_FEATURE_BUNDLE ignored: "
+                    "multi-process mesh (layout decisions need a global "
+                    "view of per-feature bin usage)")
+            else:
+                layout = self._compute_bin_layout(bins_t, F, n)
+                if layout is not None:
+                    bins_t = _pack_matrix_fn(self.mesh, layout)(bins_t)
+        elif self._missing and (_bin_pack_requested()
+                                or _feature_bundle_requested()):
+            LOG("WARNING", "DMLC_BIN_PACK/DMLC_FEATURE_BUNDLE ignored: "
+                "missing mode (the reserved NaN bin pins every feature "
+                "at full width)")
+        self._bin_layout = layout
+        if pack_wanted and self._pending_warmup is None:
+            # the deferred cold-start kick (see above): layout is now a
+            # pinned compile-time constant of the round program
+            self._maybe_start_warmup(F, n + n_pad)
         out = {
             "bins_t": bins_t,
             "y_d": jax.device_put(y, row_sharding),
@@ -1435,6 +1543,7 @@ class HistGBT(_ExternalMemoryEngine):
             "n": n,
             "n_padded": n + n_pad,
             "n_features": F,
+            "layout": layout,
         }
         # wall time of the whole quantize+stage pass (cuts, binning,
         # H2D) — dispatch-async tail included only as far as the
@@ -1444,6 +1553,65 @@ class HistGBT(_ExternalMemoryEngine):
             gbt_metrics()["phase"].observe(self.last_bin_seconds,
                                            engine="incore", phase="bin")
         return out
+
+    def _compute_bin_layout(self, bins_t, n_features: int, n_valid: int
+                            ) -> Optional["_bl.BinLayout"]:
+        """Derive the packed/bundled storage layout from the device-
+        resident bin matrix (``DMLC_BIN_PACK`` / ``DMLC_FEATURE_BUNDLE``).
+
+        Per-feature occupancy comes from the BINNED DATA (per-bin
+        occupancy counts over the real rows), not from the cuts: the
+        quantile sketch's eps-bump makes cuts strictly increasing, so
+        even a 2-valued feature carries full-width cuts AND spread-out
+        bin ids — only the counts say how many bins a feature really
+        uses (the layout compact-remaps those to dense ids) and which
+        bin is its DEFAULT for bundling.  Bundle candidates are
+        proposed on a host sample, then each is verified EXACTLY on the
+        full device matrix (any row with ≥2 off-default members
+        disqualifies the bundle) so the encode is lossless.  Returns
+        None when no pair packs and no bundle fires — the round program
+        then traces the untouched seed path."""
+        p = self.param
+        counts = _bl.bin_counts(bins_t, p.n_bins, n_valid)
+        bundles: tuple = ()
+        if _feature_bundle_requested():
+            m = min(int(bins_t.shape[1]), 1 << 16)
+            sample = np.asarray(jax.device_get(bins_t[:, :m]))
+            if m > n_valid:
+                sample = sample[:, :n_valid]
+            proposed = _bl.detect_bundles(sample, counts, p.n_bins)
+            dflt = _bl.default_bins(counts)
+            bundles = tuple(
+                b for b in proposed
+                if self._bundle_exclusive(bins_t, b, dflt, n_valid))
+            if len(proposed) != len(bundles):
+                LOG("INFO", "feature bundling: %d/%d sampled bundles "
+                    "survived exact full-data verification",
+                    len(bundles), len(proposed))
+        layout = _bl.compute_layout(counts, n_features, p.n_bins,
+                                    pack=_bin_pack_requested(),
+                                    bundles=bundles)
+        if layout is not None:
+            LOG("INFO", "bin layout: %d features -> %d physical rows "
+                "(%d int4 pairs, %d bundles; %d/%d sync bins)",
+                n_features, layout.phys_rows, len(layout.pairs),
+                sum(1 for mm in layout.members if len(mm) > 1),
+                layout.sync_bins, p.n_bins)
+        return layout
+
+    @staticmethod
+    def _bundle_exclusive(bins_t, bundle, defaults, n_valid: int) -> bool:
+        """Exact mutual-exclusivity check for one proposed bundle over
+        the FULL device matrix: no real row may have two members off
+        their DEFAULT (most frequent) bin or the shared-slot encode
+        would collide.  Padding rows hold arbitrary bin ids and are
+        masked out."""
+        nz = jnp.zeros(bins_t.shape[1], jnp.int32)
+        for f in bundle:
+            nz = nz + (bins_t[int(f)] != int(defaults[int(f)])
+                       ).astype(jnp.int32)
+        valid = jnp.arange(bins_t.shape[1]) < n_valid
+        return int(jax.device_get(jnp.max(jnp.where(valid, nz, 0)))) <= 1
 
     def _init_margin_device(self, n_padded: int) -> jax.Array:
         """Base-score margins created ON device (an np.full + device_put
@@ -1481,6 +1649,10 @@ class HistGBT(_ExternalMemoryEngine):
         CHECK(not p.objective.startswith("rank:"),
               f"fit_device does not support {p.objective} (padded layout "
               "is per-fit); use fit(qid=...)")
+        # the handle knows its own storage layout — adopt it so the round
+        # program matches the matrix even if another make_device_data ran
+        # on this model in between
+        self._bin_layout = device_data.get("layout")
         if self._pending_warmup is None:
             # no handle parked by make_device_data (or an earlier fit
             # consumed it): compile kfn + rem_fn concurrently now — a
@@ -1522,6 +1694,11 @@ class HistGBT(_ExternalMemoryEngine):
         carried = self._train_preds
         if carried is not None and getattr(carried, "shape", (0,))[0] == n_padded:
             return carried
+        CHECK(device_data.get("layout") is None,
+              "resume-fit margin replay on a packed/bundled handle needs "
+              "the carried training margins (a restored process has "
+              "none) — refit, or make the handle with DMLC_BIN_PACK=0 "
+              "and DMLC_FEATURE_BUNDLE=0")
         bins = _transpose_from_feature_major_fn(self.mesh)(
             device_data["bins_t"])
         init = self._init_margin_device(n_padded)
@@ -1560,7 +1737,8 @@ class HistGBT(_ExternalMemoryEngine):
                 p.hist_method, obj_key, mono, p.subsample,
                 p.colsample_bytree, p.num_class, self._missing,
                 os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"),
-                _hist_blocks(int(self.mesh.shape["data"])))
+                _hist_blocks(int(self.mesh.shape["data"])),
+                _grow_policy(), _max_leaves(), self._bin_layout)
 
     def _build_round_fn(self, n_features: int, n_rounds: int = 1):
         """Jitted shard_map program running ``n_rounds`` boosting rounds
@@ -1624,6 +1802,44 @@ class HistGBT(_ExternalMemoryEngine):
         # bit-identical across mesh shapes (the single-chip oracle)
         dsize = int(self.mesh.shape["data"])
         det_blocks = _hist_blocks(dsize)
+        # packed/bundled storage layout (ops.binlayout): histograms are
+        # built at [.., S, Bs] storage shape (smaller HBM reads + psum
+        # payload), then unbundled back to [.., F, B] for split
+        # evaluation, so split decisions — and save_model bytes — are
+        # untouched.  None traces the exact seed program.
+        layout = self._bin_layout
+        grow_policy = _grow_policy()
+        lossguide = grow_policy == "lossguide"
+        if lossguide:
+            CHECK(not missing,
+                  "DMLC_GROW_POLICY=lossguide with NaN/missing features "
+                  "is not supported yet — impute, or use depthwise")
+            CHECK(mono_arr is None,
+                  "DMLC_GROW_POLICY=lossguide with monotone_constraints "
+                  "is not supported (bound propagation is level-order) "
+                  "— use depthwise")
+            max_leaves = _max_leaves()
+            CHECK(max_leaves >= 0, "DMLC_MAX_LEAVES must be >= 0")
+            L_leaves = min(max_leaves, n_leaf) if max_leaves else n_leaf
+            CHECK(L_leaves >= 2,
+                  f"lossguide needs >= 2 leaves (max_depth={depth}, "
+                  f"DMLC_MAX_LEAVES={max_leaves})")
+            # the open-leaf histogram pool is the policy's working set:
+            # L·2·F·B f32 — refuse silently absurd configs up front
+            CHECK(L_leaves * 2 * n_features * B * 4 <= (256 << 20),
+                  f"lossguide histogram pool would exceed 256 MB "
+                  f"({L_leaves} leaves x {n_features} features x {B} "
+                  f"bins) — lower DMLC_MAX_LEAVES or max_depth")
+            # heap-id space: root 1, children 2i/2i+1; ids with level >
+            # depth are never created (only level < depth leaves expand)
+            NH = 1 << (depth + 1)
+            level_np = np.zeros(NH, np.int32)
+            pos_np = np.zeros(NH, np.int32)
+            for i in range(1, NH):
+                lvl = i.bit_length() - 1
+                level_np[i] = lvl
+                # leaf position = leftmost depth-level descendant
+                pos_np[i] = (i - (1 << lvl)) << (depth - lvl)
 
         def table_select(table, node, n_entries):
             """Gather-free ``table[node]`` for a tiny per-node table: a
@@ -1717,11 +1933,13 @@ class HistGBT(_ExternalMemoryEngine):
                                 node[j * rb:(j + 1) * rb],
                                 g[j * rb:(j + 1) * rb],
                                 h[j * rb:(j + 1) * rb],
-                                1, B, method, transposed=True)
+                                1, B, method, transposed=True,
+                                layout=layout)
                             for j in range(n_blk)])
                     else:
                         hist = build_histogram(bins_tl, node, g, h, 1, B,
-                                               method, transposed=True)
+                                               method, transposed=True,
+                                               layout=layout)
                     hist = hist_sync(hist)
                 else:
                     n_prev = n_nodes >> 1
@@ -1739,7 +1957,8 @@ class HistGBT(_ExternalMemoryEngine):
                                 n_prev, B, method, fuse=fuse_levels,
                                 dir_sel=(None if dir_sel is None
                                          else dir_sel[sl]),
-                                miss_bin=B - 1 if missing else None)
+                                miss_bin=B - 1 if missing else None,
+                                layout=layout)
                             lefts.append(l_j)
                             nodes2.append(nd_j)
                         left = _tree_fold(lefts)
@@ -1749,12 +1968,17 @@ class HistGBT(_ExternalMemoryEngine):
                             bins_tl, node, feat_sel, thr_sel, g, h,
                             n_prev, B, method, fuse=fuse_levels,
                             dir_sel=dir_sel,
-                            miss_bin=B - 1 if missing else None)
+                            miss_bin=B - 1 if missing else None,
+                            layout=layout)
                     left = hist_sync(left)
                     right = prev_hist - left
                     hist = jnp.stack([left, right], axis=2).reshape(
-                        2, n_nodes, left.shape[2], B)
+                        2, n_nodes, left.shape[2], left.shape[3])
+                # sibling subtraction stays in STORAGE space (prev_hist);
+                # split evaluation sees original-feature space (identity
+                # when layout is None)
                 prev_hist = hist
+                hist = _bl.unbundle_hist(hist, layout, B)
                 if mono_arr is not None or level == depth - 1:
                     if missing:
                         feat, thr, dirv, gn, cg_, ch_ = best_split_leaf(
@@ -1798,7 +2022,8 @@ class HistGBT(_ExternalMemoryEngine):
             # up to level depth-1); shared gather-free feature select
             feat_sel = table_select(feat, node, 1 << (depth - 1))
             thr_sel = table_select(thr, node, 1 << (depth - 1))
-            row_bin = select_feature_bins(bins_tl, feat_sel)          # [n]
+            row_bin = select_feature_bins(bins_tl, feat_sel,
+                                          layout=layout)             # [n]
             go_right = row_bin > thr_sel
             if missing:
                 dir_sel = table_select(dirv, node, 1 << (depth - 1))
@@ -1819,6 +2044,220 @@ class HistGBT(_ExternalMemoryEngine):
                 tree["dir"] = jnp.stack(dirs)            # [depth, half]
             return tree, table_select(leaf, node, n_leaf)
 
+        def grow_tree_lossguide(bins_tl, g, h, feat_mask):
+            """One LEAF-WISE tree on (g, h) → (tree arrays, margin delta).
+
+            LightGBM lossguide: a gain-priority queue over open leaves;
+            each of the ``L_leaves - 1`` expansions splits the open leaf
+            with the best candidate gain, builds ONE histogram (the left
+            child over only that leaf's rows) and derives the right
+            sibling by subtraction from the parent's pooled histogram.
+            Per round that is ``L_leaves`` node-histogram builds against
+            depthwise's ``2^(depth-1)`` — the win when the leaf budget
+            is far under the full tree.  Trees are emitted in the SAME
+            complete-binary-tree arrays depthwise uses (unexpanded heap
+            slots carry the depthwise degenerate encoding feat=0,
+            thr=B-1, gain=0, leaf −0.0), so save_model, predict and
+            every downstream consumer are layout-unchanged.  With an
+            unbounded budget the split STRUCTURE (feat/thr/gain) is
+            bit-identical to depthwise — pinned by
+            tests/test_lossguide.py; leaf values agree to f32 rounding
+            (subtracted vs freshly-built deepest-level histograms).
+
+            Deterministic mode (DMLC_HIST_BLOCKS) uses the same
+            per-block build + fixed-order fold + all_gather combine as
+            depthwise, and the expansion order derives only from synced
+            gains — so mesh-shape invariance survives."""
+            n_local = int(bins_tl.shape[1])
+            c_local = det_blocks // dsize if det_blocks else 0
+            n_blk = (c_local if c_local and n_local % c_local == 0
+                     else 0)
+            rb = n_local // n_blk if n_blk else 0
+
+            def hist_sync(x):
+                if not n_blk:
+                    return jax.lax.psum(x, "data")
+                if dsize == 1:
+                    return x
+                gathered = jax.lax.all_gather(x, "data")
+                return _tree_fold([gathered[i] for i in range(dsize)])
+
+            def build_one(node_build):
+                """Histogram of the single node whose rows have
+                ``node_build == 0`` (everything else -1), synced."""
+                if n_blk:
+                    hh = _tree_fold([
+                        build_histogram(
+                            bins_tl[:, j * rb:(j + 1) * rb],
+                            node_build[j * rb:(j + 1) * rb],
+                            g[j * rb:(j + 1) * rb],
+                            h[j * rb:(j + 1) * rb],
+                            1, B, method, transposed=True, layout=layout)
+                        for j in range(n_blk)])
+                else:
+                    hh = build_histogram(bins_tl, node_build, g, h, 1, B,
+                                         method, transposed=True,
+                                         layout=layout)
+                return hist_sync(hh)             # [2, 1, S, Bs]
+
+            def eval_nodes(hist_st):
+                """(feat, thr, gain, tot_g, tot_h) per node of a synced
+                STORAGE-space histogram stack [2, N, S, Bs]."""
+                ev = _bl.unbundle_hist(hist_st, layout, B)
+                f_, t_, gn_, _, _ = best_split_leaf(ev, feat_mask)
+                tot = jnp.cumsum(ev, axis=-1)[..., 0, -1]    # [2, N]
+                return f_, t_, gn_, tot[0], tot[1]
+
+            levels = jnp.asarray(level_np)
+            poss = jnp.asarray(pos_np)
+            tabs = (_bl.layout_tables(layout) if layout is not None
+                    else None)
+
+            def row_bins_of(fsel):
+                """Bins of ONE (traced-scalar) original feature for every
+                local row — the expansion descend's read."""
+                if layout is None:
+                    row = jax.lax.dynamic_slice_in_dim(bins_tl, fsel, 1, 0)
+                    return row[0].astype(jnp.int32)
+                src_f = jnp.asarray(tabs["src"][tabs["owner"]])
+                nib_f = jnp.asarray(tabs["nib"][tabs["owner"]])
+                row = jax.lax.dynamic_slice_in_dim(
+                    bins_tl, src_f[fsel], 1, 0)[0].astype(jnp.int32)
+                nb = nib_f[fsel]
+                v = jnp.where(nb == 1, row >> 4,
+                              jnp.where(nb == 0, row & 15, row))
+                if layout.has_bundles:
+                    off = jnp.asarray(tabs["off"])[fsel]
+                    wid = jnp.asarray(tabs["wid"])[fsel]
+                    bnd = jnp.asarray(tabs["bundled"])[fsel]
+                    in_seg = (v >= off) & (v < off + wid - 1)
+                    v = jnp.where(bnd,
+                                  jnp.where(in_seg, v - off + 1, 0), v)
+                if tabs["any_remap"]:
+                    # compact id → original bin id (thresholds are
+                    # original-space): orig = occ_pad[fsel, v]
+                    occ_row = jnp.asarray(tabs["occ_pad"])[fsel]
+                    orig = jnp.zeros_like(v)
+                    for k in range(_bl.PACK_WIDTH):
+                        orig = orig + jnp.where(v == k, occ_row[k], 0)
+                    v = jnp.where(jnp.asarray(tabs["remap"])[fsel],
+                                  orig, v)
+                return v
+
+            # ---- root ----
+            node = jnp.ones(n_local, jnp.int32)          # heap ids
+            root = build_one(jnp.zeros(n_local, jnp.int32))
+            f0, t0_, g0, tg0, th0 = eval_nodes(root)
+            open_ = jnp.zeros(NH, bool).at[1].set(True)
+            leaf_g = jnp.zeros(NH, jnp.float32).at[1].set(tg0[0])
+            leaf_h = jnp.zeros(NH, jnp.float32).at[1].set(th0[0])
+            cand_feat = jnp.zeros(NH, jnp.int32).at[1].set(f0[0])
+            cand_thr = jnp.full(NH, B - 1, jnp.int32).at[1].set(t0_[0])
+            cand_gain = jnp.full(NH, -jnp.inf,
+                                 jnp.float32).at[1].set(g0[0])
+            rec_feat = jnp.zeros(NH, jnp.int32)
+            rec_thr = jnp.full(NH, B - 1, jnp.int32)
+            rec_gain = jnp.zeros(NH, jnp.float32)
+            pool = jnp.zeros((L_leaves,) + root[:, 0].shape,
+                             jnp.float32).at[0].set(root[:, 0])
+            pool_id = jnp.zeros(L_leaves, jnp.int32).at[0].set(1)
+
+            def expand(carry, _):
+                (node, open_, leaf_g, leaf_h, cand_feat, cand_thr,
+                 cand_gain, pool, pool_id, rec_feat, rec_thr,
+                 rec_gain) = carry
+                # priority queue: best candidate gain among open leaves
+                # that can still grow.  A real split always has recorded
+                # gain > gamma (best_split's own split_ok gate), so the
+                # > gamma test is exactly depthwise's expansion rule.
+                gains = jnp.where(open_ & (levels < depth), cand_gain,
+                                  -jnp.inf)
+                hc = jnp.argmax(gains).astype(jnp.int32)
+                ok = gains[hc] > gamma
+                fsel = cand_feat[hc]
+                tsel = cand_thr[hc]
+                hc_eff = jnp.where(ok, hc, NH)
+                rec_feat = rec_feat.at[hc_eff].set(fsel, mode="drop")
+                rec_thr = rec_thr.at[hc_eff].set(tsel, mode="drop")
+                rec_gain = rec_gain.at[hc_eff].set(cand_gain[hc],
+                                                   mode="drop")
+                # descend the expanded leaf's rows on (fsel, tsel)
+                v = row_bins_of(fsel)
+                go_right = v > tsel
+                mine = node == hc
+                node = jnp.where(ok & mine,
+                                 2 * node + go_right.astype(jnp.int32),
+                                 node)
+                # ONE build: left child only; right = parent − left
+                node_build = jnp.where(ok & mine & ~go_right, 0, -1)
+                left = build_one(node_build)[:, 0]        # [2, S, Bs]
+                slot = jnp.argmax(pool_id == hc)
+                right = pool[slot] - left
+                f2, t2, g2, tg2, th2 = eval_nodes(
+                    jnp.stack([left, right], axis=1))
+                # children at the depth cap never expand
+                g2 = jnp.where(levels[2 * hc] < depth, g2, -jnp.inf)
+                lc = jnp.where(ok, 2 * hc, NH)
+                rc = jnp.where(ok, 2 * hc + 1, NH)
+                open_ = open_.at[hc_eff].set(False, mode="drop")
+                open_ = open_.at[lc].set(True, mode="drop")
+                open_ = open_.at[rc].set(True, mode="drop")
+                leaf_g = leaf_g.at[lc].set(tg2[0], mode="drop")
+                leaf_g = leaf_g.at[rc].set(tg2[1], mode="drop")
+                leaf_h = leaf_h.at[lc].set(th2[0], mode="drop")
+                leaf_h = leaf_h.at[rc].set(th2[1], mode="drop")
+                cand_feat = cand_feat.at[lc].set(f2[0], mode="drop") \
+                                     .at[rc].set(f2[1], mode="drop")
+                cand_thr = cand_thr.at[lc].set(t2[0], mode="drop") \
+                                   .at[rc].set(t2[1], mode="drop")
+                cand_gain = cand_gain.at[lc].set(g2[0], mode="drop") \
+                                     .at[rc].set(g2[1], mode="drop")
+                # pool bookkeeping: parent slot → left child; first free
+                # slot (searched BEFORE the parent overwrite) → right
+                free = jnp.argmax(pool_id == 0)
+                slot_eff = jnp.where(ok, slot, L_leaves)
+                free_eff = jnp.where(ok, free, L_leaves)
+                pool = pool.at[slot_eff].set(left, mode="drop")
+                pool = pool.at[free_eff].set(right, mode="drop")
+                pool_id = pool_id.at[slot_eff].set(2 * hc, mode="drop")
+                pool_id = pool_id.at[free_eff].set(2 * hc + 1,
+                                                   mode="drop")
+                return (node, open_, leaf_g, leaf_h, cand_feat, cand_thr,
+                        cand_gain, pool, pool_id, rec_feat, rec_thr,
+                        rec_gain), None
+
+            carry = (node, open_, leaf_g, leaf_h, cand_feat, cand_thr,
+                     cand_gain, pool, pool_id, rec_feat, rec_thr,
+                     rec_gain)
+            carry, _ = jax.lax.scan(expand, carry, None,
+                                    length=L_leaves - 1)
+            (node, open_, leaf_g, leaf_h, _, _, _, _, _, rec_feat,
+             rec_thr, rec_gain) = carry
+            # leaf table in depthwise's positional layout: every slot an
+            # open leaf doesn't own is a depthwise empty leaf, whose
+            # value is exactly −0.0 (−(+0)/(0+λ)·η)
+            w_all = (-_maybe_l1(leaf_g, alpha) / (leaf_h + lam)) * eta
+            pos_eff = jnp.where(open_, poss, n_leaf)
+            leaf = jnp.full(n_leaf, -0.0,
+                            jnp.float32).at[pos_eff].set(w_all,
+                                                         mode="drop")
+            tree = {
+                "feat": jnp.stack([
+                    jnp.pad(rec_feat[1 << lv:1 << (lv + 1)],
+                            (0, half - (1 << lv))) for lv in range(depth)]),
+                "thr": jnp.stack([
+                    jnp.pad(rec_thr[1 << lv:1 << (lv + 1)],
+                            (0, half - (1 << lv))) for lv in range(depth)]),
+                "gain": jnp.stack([
+                    jnp.pad(rec_gain[1 << lv:1 << (lv + 1)],
+                            (0, half - (1 << lv))) for lv in range(depth)]),
+                "leaf": leaf,                            # [n_leaf]
+            }
+            delta = table_select(jnp.where(open_, w_all, 0.0), node, NH)
+            return tree, delta
+
+        grow = grow_tree_lossguide if lossguide else grow_tree
+
         n_class = p.num_class
 
         def round_body(bins_tl, y_l, w_l, preds_l, key=None):
@@ -1832,7 +2271,7 @@ class HistGBT(_ExternalMemoryEngine):
                 if keep is not None:
                     g = jnp.where(keep, g, 0.0)
                     h = jnp.where(keep, h, 0.0)
-                tree, delta = grow_tree(bins_tl, g, h, feat_mask)
+                tree, delta = grow(bins_tl, g, h, feat_mask)
                 return preds_l + delta, tree
             # multiclass: preds_l [n, K]; one tree per class per round,
             # built on the full-softmax gradients (XGBoost multi:softmax)
@@ -1845,7 +2284,7 @@ class HistGBT(_ExternalMemoryEngine):
             class_trees = []
             deltas = []
             for c in range(n_class):
-                tree_c, delta_c = grow_tree(
+                tree_c, delta_c = grow(
                     bins_tl, g_all[:, c], h_all[:, c], feat_mask)
                 class_trees.append(tree_c)
                 deltas.append(delta_c)

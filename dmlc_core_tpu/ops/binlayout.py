@@ -1,0 +1,473 @@
+"""Per-feature bin-width layouts: int4 bin packing + exclusive feature
+bundling for the histogram round kernel.
+
+The transposed bin matrix the round program streams every level is
+``uint8 [F, n]`` regardless of how many bins each feature actually
+uses — a 2-valued flag burns the same HBM bandwidth as a 256-bin
+continuous feature.  A :class:`BinLayout` describes two exact,
+independently-gated storage transforms (LightGBM's EFB and int4
+packing, adapted to the TPU feature-major layout):
+
+* **Packing** (``DMLC_BIN_PACK=1``): storage features whose OCCUPIED
+  bin count is ≤ 16 are compact-remapped (occupied original bin ids →
+  dense ``[0, count)``) and paired two-per-byte (low/high nibble) in
+  the physical matrix — halving the HBM bin traffic the histogram
+  kernel pays for narrow features.  Remap + nibble extraction are
+  exact integer relabelings, so every histogram method produces
+  bit-identical cell values once :func:`unbundle_hist` scatters cells
+  back to original bin positions (pinned by tests/test_binpack.py).
+* **Bundling** (``DMLC_FEATURE_BUNDLE=1``): mutually-exclusive
+  (near-one-hot) feature blocks fuse into ONE multi-bin storage
+  feature.  Member f's bins ``[1, w_f)`` map to the storage segment
+  ``[off_f, off_f + w_f - 1)``; storage bin 0 means "every member at
+  its default bin 0".  Exclusivity is verified EXACTLY on the full
+  matrix before a bundle is kept (the sampled detector only proposes),
+  and :func:`unbundle_hist` reconstructs per-member histograms at
+  split-evaluation time, so split decisions stay in the ORIGINAL
+  feature space and ``save_model`` bytes are unchanged whenever no
+  bundle fires (a trivial layout is represented as ``None`` — the
+  untouched seed code path).
+
+Layouts are hashable (jit-static) and mesh-shape-independent: widths
+come from a global max over the binned matrix, so 1-chip and N-chip
+fits derive the SAME layout and the ``DMLC_HIST_BLOCKS`` byte-parity
+contract survives both knobs.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlc_core_tpu.base.logging import CHECK
+
+__all__ = ["BinLayout", "compute_layout", "used_bin_widths",
+           "bin_counts", "compact_counts", "default_bins",
+           "detect_bundles", "pack_matrix", "unpack_matrix",
+           "unbundle_hist", "select_bins", "PACK_WIDTH"]
+
+#: max COMPACT bin count eligible for nibble packing (two features/byte)
+PACK_WIDTH = 16
+
+
+class BinLayout(NamedTuple):
+    """Static storage layout of the transposed bin matrix.
+
+    ``members[s]`` lists the original features carried by storage
+    feature ``s`` as ``(orig_feat, offset, width)`` triples — length 1
+    for a plain feature (offset 0), >1 for a bundle.  ``pairs`` holds
+    nibble-packed storage-row pairs (``byte = lo | hi << 4``) and
+    ``singles`` the remaining storage rows in physical order; the
+    packed region is padded to an 8-row multiple (Pallas sublane
+    groups) with zero rows.
+
+    ``bin_maps[f]`` is the COMPACT bin remap of original feature ``f``:
+    the sorted tuple of occupied original bin ids (always including 0),
+    or ``None`` for a wide feature stored at its raw ids.  Quantile
+    cuts are eps-bumped to stay strictly increasing, so a 3-valued
+    feature's raw bin ids spread over ~n_bins — only the remap makes it
+    4-bit-packable.  Storage holds compact ids; split evaluation
+    scatters histogram cells back to the ORIGINAL bin positions
+    (:func:`unbundle_hist`), which is EXACT: unoccupied bins hold
+    exact zeros in both the remapped and the plain build, so the eval
+    histogram is bit-identical cell-for-cell and split decisions (and
+    ``save_model`` bytes) cannot move.
+    """
+    n_features: int                                  # original F
+    n_bins: int                                      # split-eval width B
+    widths: Tuple[int, ...]                          # per-storage width
+    members: Tuple[Tuple[Tuple[int, int, int], ...], ...]
+    pairs: Tuple[Tuple[int, int], ...]
+    singles: Tuple[int, ...]
+    bin_maps: Tuple[Optional[Tuple[int, ...]], ...]  # per-ORIGINAL feat
+
+    @property
+    def storage_features(self) -> int:
+        return len(self.widths)
+
+    @property
+    def sync_bins(self) -> int:
+        """Histogram build/psum width: the widest storage feature."""
+        return max(self.widths)
+
+    @property
+    def packed_rows(self) -> int:
+        """Physical rows in the packed region (8-row padded)."""
+        p = len(self.pairs)
+        return -(-p // 8) * 8 if p else 0
+
+    @property
+    def phys_rows(self) -> int:
+        return self.packed_rows + len(self.singles)
+
+    @property
+    def has_bundles(self) -> bool:
+        return any(len(m) > 1 for m in self.members)
+
+    def phys_bytes_per_row(self) -> int:
+        """Bin-matrix bytes per data row — the HBM bill one kernel pass
+        pays per row (uint8 physical rows)."""
+        return self.phys_rows
+
+
+def used_bin_widths(bins_t: jax.Array) -> np.ndarray:
+    """Per-feature used bin width (max bin + 1) of a ``[F, n]`` binned
+    matrix.  Quantile cuts CANNOT be the source of this: the sketch's
+    eps-bump keeps cut vectors strictly increasing, so a 2-valued
+    feature still carries ~n_bins distinct cuts — only the binned data
+    reveals the real width.  The max reduces over the (sharded) row
+    axis, so every mesh shape derives identical widths.
+    """
+    return np.asarray(jax.device_get(jnp.max(bins_t.astype(jnp.int32),
+                                             axis=1))) + 1
+
+
+def bin_counts(bins_t: jax.Array, n_bins: int,
+               n_valid: Optional[int] = None) -> np.ndarray:
+    """Per-feature bin occupancy COUNTS ``int [F, n_bins]`` of a
+    ``[F, n]`` binned matrix, over the first ``n_valid`` rows (padding
+    rows hold an arbitrary bin id and MUST be excluded — they differ
+    between mesh shapes).  The eps-bumped quantile sketch SPREADS a
+    low-cardinality feature's bin ids across ``[0, n_bins)`` (a
+    3-valued feature lands at e.g. {0, 11, 22}), so ``max + 1`` is
+    useless as a packability signal — per-bin occupancy is the real
+    one, and the count argmax picks each feature's DEFAULT (most
+    frequent) bin for bundling.  An integer scatter-add over the
+    (sharded) row axis — exactly row-order independent, so every mesh
+    shape derives the identical count matrix.
+    """
+    F, n = bins_t.shape
+    if n_valid is None or n_valid >= n:
+        vals = jnp.ones((), jnp.int32)
+    else:
+        vals = (jnp.arange(n, dtype=jnp.int32) < n_valid
+                ).astype(jnp.int32)
+    cnt = jnp.zeros((F, n_bins), jnp.int32).at[
+        jnp.arange(F, dtype=jnp.int32)[:, None],
+        bins_t.astype(jnp.int32)].add(vals)
+    return np.asarray(jax.device_get(cnt))
+
+
+def compact_counts(counts: np.ndarray) -> np.ndarray:
+    """Per-feature COMPACT bin count: number of occupied bins."""
+    return (np.asarray(counts) > 0).sum(axis=1).astype(np.int64)
+
+
+def default_bins(counts: np.ndarray) -> np.ndarray:
+    """Per-feature DEFAULT bin: the most frequent occupied bin (ties →
+    lowest id; deterministic).  The bundle encode treats a member at
+    its default as "absent" — LightGBM's EFB default-bin rule, needed
+    because quantile binning does NOT put the common value at bin 0."""
+    return np.asarray(counts).argmax(axis=1).astype(np.int64)
+
+
+def compute_layout(counts: np.ndarray, n_features: int, n_bins: int, *,
+                   pack: bool = True,
+                   bundles: Tuple[Tuple[int, ...], ...] = (),
+                   ) -> Optional[BinLayout]:
+    """Build a :class:`BinLayout` from the per-feature bin occupancy
+    counts (``int [F, n_bins]``, see :func:`bin_counts`) and verified
+    exclusive bundles.  Features whose occupied count is ≤
+    ``PACK_WIDTH`` get a compact remap (``bin_maps``); storage widths
+    are compact counts for remapped features and raw ``max + 1`` for
+    wide ones.  A bundled member's map lists its DEFAULT bin first
+    (compact id 0 ⇒ "absent from the bundle row").  Returns ``None``
+    when the layout would be trivial (no pair packs, no bundles) so
+    callers fall back to the untouched uint8 path — the "no bundle
+    fires ⇒ byte-identical save_model" contract is then free.
+    """
+    counts = np.asarray(counts)
+    CHECK(counts.shape == (n_features, n_bins),
+          "counts/feature-count mismatch")
+    presence = counts > 0
+    occs = [tuple(int(i) for i in np.nonzero(presence[f])[0]) or (0,)
+            for f in range(n_features)]
+    defaults = default_bins(counts)
+    maxw = [max(int(np.nonzero(presence[f])[0][-1]) + 1, 1)
+            if presence[f].any() else 1 for f in range(n_features)]
+    remapped = [len(occs[f]) <= PACK_WIDTH for f in range(n_features)]
+    cnt = [len(occs[f]) for f in range(n_features)]
+    in_bundle = {}
+    for b in bundles:
+        for f in b:
+            CHECK(f not in in_bundle, f"feature {f} in two bundles")
+            CHECK(remapped[f],
+                  f"bundle member {f} not compact (count {cnt[f]})")
+            in_bundle[f] = b
+    bin_maps = []
+    for f in range(n_features):
+        if not remapped[f]:
+            bin_maps.append(None)
+        elif f in in_bundle:               # default-first compact order
+            d = int(defaults[f])
+            bin_maps.append((d,) + tuple(i for i in occs[f] if i != d))
+        else:
+            bin_maps.append(occs[f])
+    bin_maps = tuple(bin_maps)
+    st_widths, st_members = [], []
+    emitted = set()
+    for f in range(n_features):
+        b = in_bundle.get(f)
+        if b is None:
+            w = cnt[f] if remapped[f] else maxw[f]
+            st_widths.append(max(w, 1))
+            st_members.append(((f, 0, w),))
+            continue
+        if b[0] != f or b in emitted:
+            continue                       # bundle emitted at first member
+        emitted.add(b)
+        off, mems = 1, []
+        for g in b:                        # member widths are COMPACT
+            mems.append((g, off, cnt[g]))
+            off += max(cnt[g] - 1, 0)
+        CHECK(off <= n_bins,
+              f"bundle width {off} exceeds n_bins={n_bins}")
+        st_widths.append(off)
+        st_members.append(tuple(mems))
+    packable = ([s for s, w in enumerate(st_widths) if w <= PACK_WIDTH]
+                if pack else [])
+    pairs = tuple(zip(packable[0::2], packable[1::2]))
+    paired = {s for pr in pairs for s in pr}
+    singles = tuple(s for s in range(len(st_widths)) if s not in paired)
+    if not pairs and not any(len(m) > 1 for m in st_members):
+        return None
+    return BinLayout(n_features=n_features, n_bins=n_bins,
+                     widths=tuple(st_widths), members=tuple(st_members),
+                     pairs=pairs, singles=singles, bin_maps=bin_maps)
+
+
+def detect_bundles(sample_bins_t: np.ndarray, counts: np.ndarray,
+                   n_bins: int, max_conflicts: int = 0,
+                   ) -> Tuple[Tuple[int, ...], ...]:
+    """Greedy exclusive-feature-bundle PROPOSER over a host bin sample
+    ``[F, m]`` (LightGBM's EFB, exact-conflict variant): two features
+    conflict when any sampled row has both OFF THEIR DEFAULT bin (the
+    most frequent bin per :func:`default_bins` — quantile binning does
+    not place the common value at bin 0).  ``counts`` is the full-data
+    ``[F, n_bins]`` occupancy matrix (:func:`bin_counts`) — defaults
+    must be mesh-invariant, so they come from the full data even though
+    conflicts are only sampled here.  Members must be compact
+    (≤ ``PACK_WIDTH``) so the layout can carry their remap tables.
+    Proposals MUST still be verified against the full matrix (mutual
+    exclusivity on a sample is not exclusivity) — see
+    ``HistGBT._bundle_exclusive``.
+    """
+    F = sample_bins_t.shape[0]
+    ccnt = compact_counts(counts)
+    dflt = default_bins(counts)
+    nz = sample_bins_t != dflt[:, None]                  # [F, m] off-default
+    # near-one-hot candidates: sparse compact features
+    density = nz.mean(axis=1)
+    cand = sorted((f for f in range(F)
+                   if density[f] <= 0.5 and 2 <= ccnt[f] <= PACK_WIDTH),
+                  key=lambda f: density[f])
+    bundles, used = [], set()
+    for f in cand:
+        if f in used:
+            continue
+        group, mask, width = [f], nz[f].copy(), int(ccnt[f])
+        for g in cand:
+            if g in used or g == f or g in group:
+                continue
+            if width + int(ccnt[g]) - 1 > n_bins:
+                continue
+            if int(np.count_nonzero(mask & nz[g])) > max_conflicts:
+                continue
+            group.append(g)
+            mask |= nz[g]
+            width += int(ccnt[g]) - 1
+        if len(group) >= 2:
+            used.update(group)
+            bundles.append(tuple(sorted(group)))
+    return tuple(bundles)
+
+
+@lru_cache(maxsize=64)
+def layout_tables(layout: BinLayout) -> dict:
+    """Static numpy index tables derived from a layout (cached — the
+    layout is hashable and lives for the fit)."""
+    S = layout.storage_features
+    Pp = layout.packed_rows
+    src = np.zeros(S, np.int32)            # physical row of storage s
+    nib = np.full(S, -1, np.int32)         # 0=low nibble, 1=high, -1=byte
+    logical = np.zeros(S, np.int32)        # kernel-logical row of storage s
+    for i, (a, b) in enumerate(layout.pairs):
+        src[a], nib[a], logical[a] = i, 0, 2 * i
+        src[b], nib[b], logical[b] = i, 1, 2 * i + 1
+    for j, s in enumerate(layout.singles):
+        src[s], logical[s] = Pp + j, 2 * Pp + j
+    F = layout.n_features
+    owner = np.zeros(F, np.int32)          # storage feature of original f
+    off = np.zeros(F, np.int32)
+    wid = np.zeros(F, np.int32)
+    bundled = np.zeros(F, bool)
+    for s, mems in enumerate(layout.members):
+        for f, o, w in mems:
+            owner[f], off[f], wid[f] = s, o, w
+            bundled[f] = len(mems) > 1
+    # compact remap tables: occ_pad[f, c] = original bin of compact id c
+    # (sentinel n_bins beyond the width — never matched, never scattered)
+    remap = np.array([m is not None for m in layout.bin_maps], bool)
+    occ_pad = np.full((F, PACK_WIDTH), layout.n_bins, np.int32)
+    for f, m in enumerate(layout.bin_maps):
+        if m is not None:
+            occ_pad[f, :len(m)] = m
+    # storage-cell → eval-cell scatter (plain features; bundles are
+    # reconstructed by the tot − segment pass in unbundle_hist)
+    Bs = layout.sync_bins
+    sc_feat = np.full((S, Bs), F, np.int32)      # F ⇒ dropped
+    sc_bin = np.zeros((S, Bs), np.int32)
+    for s, mems in enumerate(layout.members):
+        if len(mems) != 1:
+            continue
+        f, _, w = mems[0]
+        m = layout.bin_maps[f]
+        for c in range(w):
+            sc_feat[s, c] = f
+            sc_bin[s, c] = m[c] if m is not None else c
+    return dict(src=src, nib=nib, logical=logical, owner=owner,
+                off=off, wid=wid, bundled=bundled,
+                bundled_feats=tuple(int(f) for f in np.nonzero(bundled)[0]),
+                remap=remap, any_remap=bool(remap.any()),
+                occ_pad=occ_pad, sc_feat=sc_feat, sc_bin=sc_bin)
+
+
+def pack_matrix(bins_t: jax.Array, layout: BinLayout) -> jax.Array:
+    """``[F, n]`` uint8 original matrix → ``[phys_rows, n]`` uint8
+    physical matrix (bundle encode, then nibble-pack).  Pure elementwise
+    /feature-axis work — row sharding is untouched, so it runs
+    shard-local under any mesh."""
+    t = layout_tables(layout)
+    v = bins_t.astype(jnp.int32)
+    if t["any_remap"]:
+        # original bin id → compact id: c = Σ_k k·[v == occ[f, k]].
+        # Equality (not rank) because a bundled member's map is
+        # default-first, not sorted; the n_bins sentinel in the padding
+        # never matches.  Only padding rows hold unoccupied ids — they
+        # fall to compact 0 and carry zero gradient weight anyway.
+        # Unrolled over the 16-entry table to keep memory at O(F·n).
+        c = jnp.zeros_like(v)
+        occ_pad = t["occ_pad"]
+        for k in range(1, PACK_WIDTH):
+            c = c + k * (v == jnp.asarray(occ_pad[:, k])[:, None]
+                         ).astype(jnp.int32)
+        v = jnp.where(jnp.asarray(t["remap"])[:, None], c, v)
+    if layout.has_bundles:
+        # member encode: default (bin 0) → 0, bin v ≥ 1 → off + v - 1;
+        # exclusivity (verified) makes the per-storage sum exact
+        enc = jnp.where(jnp.asarray(t["bundled"])[:, None],
+                        jnp.where(v > 0,
+                                  jnp.asarray(t["off"])[:, None] + v - 1, 0),
+                        v)
+        storage = jnp.zeros((layout.storage_features, bins_t.shape[1]),
+                            jnp.int32).at[jnp.asarray(t["owner"])].add(enc)
+    else:
+        storage = v                        # storage order == original order
+    if layout.pairs:
+        a_idx = jnp.asarray(np.array([p[0] for p in layout.pairs],
+                                     np.int32))
+        b_idx = jnp.asarray(np.array([p[1] for p in layout.pairs],
+                                     np.int32))
+        packed = storage[a_idx] | (storage[b_idx] << 4)
+        pad = layout.packed_rows - len(layout.pairs)
+        if pad:
+            packed = jnp.pad(packed, ((0, pad), (0, 0)))
+        parts = [packed]
+    else:
+        parts = []
+    if layout.singles:
+        parts.append(storage[jnp.asarray(np.array(layout.singles,
+                                                  np.int32))])
+    return jnp.concatenate(parts, axis=0).astype(jnp.uint8)
+
+
+def unpack_matrix(phys: jax.Array, layout: BinLayout) -> jax.Array:
+    """``[phys_rows, n]`` physical matrix → ``[S, n]`` uint8 STORAGE
+    matrix (nibbles extracted; bundles left fused — histograms build in
+    storage space).  Exact inverse of the packing step."""
+    t = layout_tables(layout)
+    m = phys[jnp.asarray(t["src"])].astype(jnp.int32)        # [S, n]
+    nib = jnp.asarray(t["nib"])[:, None]
+    v = jnp.where(nib == 1, m >> 4, jnp.where(nib == 0, m & 15, m))
+    return v.astype(jnp.uint8)
+
+
+def unbundle_hist(hist: jax.Array, layout: Optional[BinLayout],
+                  n_bins: int) -> jax.Array:
+    """Storage-space histogram ``[2, N, S, Bs]`` → split-eval histogram
+    ``[2, N, F, B]`` in the ORIGINAL feature AND bin space.
+
+    Plain storage cells scatter back to their original bin positions
+    (compact id ``c`` of feature ``f`` → ``bin_maps[f][c]``; identity
+    for wide features) — bins unoccupied in the data hold exact zeros
+    on both paths, so split evaluation sees a bit-identical histogram.
+    A bundle member's bins slice out of its storage segment onto the
+    member's occupied positions; its bin 0 is ``node_total − Σ segment``
+    — mathematically exact, with last-ulp float reassociation relative
+    to a direct build (why ``DMLC_FEATURE_BUNDLE`` defaults off and the
+    byte-parity contract is scoped to "no bundle fires").
+    """
+    if layout is None:
+        return hist
+    t = layout_tables(layout)
+    Bs = hist.shape[-1]
+    if not layout.has_bundles and not t["any_remap"]:
+        if Bs == n_bins:
+            return hist
+        return jnp.pad(hist, ((0, 0), (0, 0), (0, 0), (0, n_bins - Bs)))
+    # plain storage cells scatter to their ORIGINAL (feat, bin) positions
+    # via static index tables; sentinel feat F drops the dead cells.  The
+    # result is cell-for-cell bit-identical to the unpacked build: each
+    # target cell accumulates the same rows in the same order, and the
+    # compact remap only RELABELS cells — unoccupied bins are exact
+    # zeros on both paths.
+    out = jnp.zeros(hist.shape[:2] + (layout.n_features, n_bins),
+                    hist.dtype)
+    out = out.at[:, :, jnp.asarray(t["sc_feat"]),
+                 jnp.asarray(t["sc_bin"])].set(hist, mode="drop")
+    if layout.has_bundles:
+        tot = jnp.cumsum(hist, axis=-1)[..., -1]             # [2, N, S]
+        for f in t["bundled_feats"]:
+            s, o, w = int(t["owner"][f]), int(t["off"][f]), int(t["wid"][f])
+            occ = np.asarray(layout.bin_maps[f], np.int32)   # len w
+            seg = hist[:, :, s, o:o + w - 1]                 # [2, N, w-1]
+            b0 = tot[:, :, s] - seg.sum(-1)
+            col = jnp.concatenate([b0[..., None], seg], axis=-1)
+            out = out.at[:, :, f, jnp.asarray(occ)].set(col)
+    return out
+
+
+def select_bins(phys: jax.Array, feat_sel: jax.Array,
+                layout: BinLayout) -> jax.Array:
+    """Per-row bin of each row's selected ORIGINAL feature, from the
+    physical matrix: one compare-and-sum pass over the physical rows
+    (same gather-free idiom as ``select_feature_bins``), then nibble
+    extraction and bundle decode by per-row table lookups."""
+    t = layout_tables(layout)
+    # per-original-feature tables: storage tables composed through owner
+    src = jnp.asarray(t["src"][t["owner"]])[feat_sel]        # [n]
+    f_iota = jnp.arange(phys.shape[0], dtype=jnp.int32)[:, None]
+    raw = jnp.sum(jnp.where(src[None, :] == f_iota,
+                            phys.astype(jnp.int32), 0), axis=0)
+    nib = jnp.asarray(t["nib"][t["owner"]])[feat_sel]
+    v = jnp.where(nib == 1, raw >> 4, jnp.where(nib == 0, raw & 15, raw))
+    if layout.has_bundles:
+        bundled = jnp.asarray(t["bundled"])[feat_sel]
+        off = jnp.asarray(t["off"])[feat_sel]
+        wid = jnp.asarray(t["wid"])[feat_sel]
+        in_seg = (v >= off) & (v < off + wid - 1)
+        v = jnp.where(bundled, jnp.where(in_seg, v - off + 1, 0), v)
+    if t["any_remap"]:
+        # compact id → ORIGINAL bin id (thresholds from split eval are
+        # original-space): orig = occ_pad[f, v] by 16-way compare-sum
+        occ_pad = t["occ_pad"]
+        orig = jnp.zeros_like(v)
+        for k in range(PACK_WIDTH):
+            orig = orig + jnp.where(v == k,
+                                    jnp.asarray(occ_pad[:, k])[feat_sel], 0)
+        v = jnp.where(jnp.asarray(t["remap"])[feat_sel], orig, v)
+    return v

@@ -38,32 +38,60 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from dmlc_core_tpu.base.logging import log_fatal
+from dmlc_core_tpu.base.logging import CHECK, log_fatal
+from dmlc_core_tpu.ops import binlayout as _bl
 
 __all__ = ["build_histogram", "fused_descend_histogram",
            "select_feature_bins", "histogram_methods",
-           "reference_histogram", "hist_psum_bytes_per_round"]
+           "reference_histogram", "hist_psum_bytes_per_round",
+           "leaves_built_per_round"]
+
+
+def leaves_built_per_round(depth: int, grow_policy: str = "depthwise",
+                           max_leaves: int = 0) -> int:
+    """Histogram BUILDS one boosting round pays (sibling subtraction
+    derives the rest for free).  Depth-wise: the root plus every level's
+    left children — ``2^(depth-1)``.  Loss-guide builds only for the
+    expanded leaf: the root plus one per expansion — ``max_leaves``
+    total, independent of depth.  Feeds bench.py's
+    ``kernel.leaves_built_per_round`` regression field."""
+    if grow_policy == "lossguide":
+        return min(max_leaves, 1 << depth) if max_leaves else 1 << depth
+    return 1 if depth <= 1 else 1 << (depth - 1)
 
 
 def hist_psum_bytes_per_round(depth: int, n_features: int,
-                              n_bins: int) -> int:
+                              n_bins: int, *, layout=None,
+                              grow_policy: str = "depthwise",
+                              max_leaves: int = 0) -> int:
     """Per-chip bytes contributed to the in-step histogram-sync
-    allreduce by ONE boosting round (one tree) of the sibling-subtracted
-    level-wise engine.
+    allreduce by ONE boosting round (one tree).
 
-    Per level ℓ only the built histograms cross the wire: the root at
-    level 0, then LEFT children only (``n_build = 2^(ℓ-1)``) — sibling
-    subtraction halves the psum payload below the root.  Each built node
-    is ``[2, F, B]`` f32 (grad + hess planes).  This is the single
-    analytic model behind bench.py's ``hist_psum_bytes_per_round`` field
-    and the live ``dmlc_histogram_psum_bytes_total`` counter — the
-    cross-chip traffic the multi-chip flagship pays per round (the
-    rabit-allreduce replacement's byte bill).
+    Per level ℓ of the sibling-subtracted depth-wise engine only the
+    built histograms cross the wire: the root at level 0, then LEFT
+    children only (``n_build = 2^(ℓ-1)``).  The loss-guide engine syncs
+    one built node per expansion (root + ``max_leaves − 1``).  Each
+    built node is ``[2, S, Bs]`` f32 (grad + hess planes) where a
+    non-trivial :class:`~dmlc_core_tpu.ops.binlayout.BinLayout` shrinks
+    S below F (bundling) and Bs below B (histograms build and sync at
+    the widest USED storage width, then zero-pad back before split
+    evaluation).  This is the single analytic model behind bench.py's
+    ``hist_psum_bytes_per_round`` field and the live
+    ``dmlc_histogram_psum_bytes_total`` counter — the cross-chip
+    traffic the multi-chip flagship pays per round (the rabit-allreduce
+    replacement's byte bill).
     """
+    if layout is not None:
+        n_features = layout.storage_features
+        n_bins = layout.sync_bins
+    node_bytes = 2 * n_features * n_bins * 4
+    if grow_policy == "lossguide":
+        return leaves_built_per_round(depth, "lossguide",
+                                      max_leaves) * node_bytes
     total = 0
     for level in range(depth):
         n_build = 1 if level == 0 else 1 << (level - 1)
-        total += 2 * n_build * n_features * n_bins * 4
+        total += n_build * node_bytes
     return total
 
 # rows per MXU block: one-hot RHS is [R, F·B] bf16 — at F=28, B=256 and
@@ -131,6 +159,7 @@ def build_histogram(
     method: str = "auto",
     *,
     transposed: bool = False,
+    layout=None,
 ) -> jax.Array:
     """Return ``hist[2, n_nodes, F, n_bins]`` — plane 0 Σgrad, plane 1 Σhess.
 
@@ -141,7 +170,43 @@ def build_histogram(
     kernel's native layout.  The training loop stores bins transposed so
     the per-level kernel never re-transposes the matrix (a full HBM
     round-trip per histogram otherwise).
+
+    ``layout`` (a :class:`~dmlc_core_tpu.ops.binlayout.BinLayout`) means
+    ``bins`` is the PHYSICAL ``[phys_rows, n]`` matrix (nibble-packed /
+    bundled) and the result is the STORAGE-space histogram
+    ``[2, n_nodes, S, layout.sync_bins]`` — callers unbundle/pad back to
+    ``[2, N, F, n_bins]`` via ``binlayout.unbundle_hist`` before split
+    evaluation.  The Pallas kernel reads packed bytes natively (the HBM
+    win); segment/matmul unpack to the storage matrix first (exact
+    integer nibble extraction, so cell values stay bit-identical to an
+    unpacked build — the cross-method parity contract).
     """
+    if layout is not None:
+        CHECK(transposed, "layout= requires the transposed [F, n] matrix")
+        n_bins = layout.sync_bins
+        if method == "auto":
+            if jax.default_backend() == "tpu":
+                method = ("pallas" if _pallas_ok(n_bins, layout.phys_rows,
+                                                 n_nodes, 1)
+                          else "matmul")
+            else:
+                method = "segment"
+        if method == "pallas" and not _pallas_ok(n_bins, layout.phys_rows,
+                                                 n_nodes, 1):
+            method = "matmul"
+        if method == "pallas":
+            if layout.pairs:
+                return _hist_pallas(bins, node_id, grad, hess, n_nodes,
+                                    n_bins, transposed=True, layout=layout)
+            # bundle-only layout: physical == storage, plain kernel
+            return _hist_pallas(bins, node_id, grad, hess, n_nodes,
+                                n_bins, transposed=True)
+        storage = _bl.unpack_matrix(bins, layout)
+        if method == "segment":
+            return _hist_segment(storage.T, node_id, grad, hess,
+                                 n_nodes, n_bins)
+        return _hist_matmul(storage.T, node_id, grad, hess,
+                            n_nodes, n_bins)
     F = bins.shape[0] if transposed else bins.shape[1]
     itemsize = jnp.dtype(bins.dtype).itemsize
     if method == "auto":
@@ -233,7 +298,7 @@ def _hist_matmul(bins, node_id, grad, hess, n_nodes, n_bins,
 
 
 def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
-                        *, n_nodes, hi, lo, pack):
+                        *, n_nodes, hi, lo, pack, n_pack_groups=0):
     """One row-tile of the FACTORED, SUBTILE-PACKED one-hot matmul.
 
     bin = hi_part·lo + lo_part.  Per feature, ONE MXU dot
@@ -267,11 +332,24 @@ def _hist_pallas_kernel(bins_ref, node_ref, g_ref, h_ref, out_ref,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     _accum_hist(bins_ref, out_ref, node, g, h,
-                n_nodes=n_nodes, hi=hi, lo=lo, pack=pack)
+                n_nodes=n_nodes, hi=hi, lo=lo, pack=pack,
+                n_pack_groups=n_pack_groups)
 
 
-def _accum_hist(bins_ref, out_ref, node, g, h, *, n_nodes, hi, lo, pack):
-    """Shared histogram accumulation loop (see _hist_pallas_kernel doc)."""
+def _accum_hist(bins_ref, out_ref, node, g, h, *, n_nodes, hi, lo, pack,
+                n_pack_groups=0):
+    """Shared histogram accumulation loop (see _hist_pallas_kernel doc).
+
+    ``n_pack_groups`` > 0 marks the first ``8·n_pack_groups`` physical
+    rows as NIBBLE-PACKED (two int4 storage features per byte, see
+    ops/binlayout.py): each packed physical row emits TWO logical
+    output rows — low nibble to ``2r``, high nibble to ``2r+1`` — so
+    one HBM byte feeds two features' one-hot dots (the packed-bin HBM
+    win).  The unpacked remainder follows at logical offset
+    ``16·n_pack_groups``.  With ``n_pack_groups == 0`` the trace is
+    IDENTICAL to the pre-layout kernel (the packed loop is not even
+    traced), preserving bit-parity for the default path.
+    """
     F, T = bins_ref.shape
     nh = n_nodes * hi
     nh_iota = jax.lax.broadcasted_iota(jnp.int32, (pack * nh, T), 0)
@@ -283,6 +361,37 @@ def _accum_hist(bins_ref, out_ref, node, g, h, *, n_nodes, hi, lo, pack):
     t0_node = jnp.where(valid, sub_base + jnp.where(valid, node, 0) * hi,
                         jnp.int32(-(1 << 20)))                        # [1, T]
 
+    def emit(t0s, los, k, row):
+        # ONE [nh, T] compare then scale by g and h (the grad/hess
+        # planes share the one-hot) — 2× cheaper than comparing a
+        # [2·nh, T] iota twice.  compare→astype→mul (NOT where):
+        # Mosaic can't relayout an i1 mask against a [1, T]-
+        # replicated where operand.
+        oh = (nh_iota == t0s[k:k + 1]).astype(jnp.bfloat16)           # [Snh, T]
+        lhs = jnp.concatenate([oh * g, oh * h], axis=0)               # [2Snh, T]
+        rhs = (lo_iota == los[k:k + 1]).astype(jnp.bfloat16)          # [lo, T]
+        d = jax.lax.dot_general(
+            lhs, rhs,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                              # [2Snh, lo]
+        idx = (pl.ds(row, 1), slice(None), slice(None))
+        out_ref[idx] = out_ref[idx] + d[None]
+
+    if n_pack_groups:
+        def pbody(fg, carry):
+            base = pl.multiple_of(fg * 8, 8)
+            blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)       # [8, T]
+            for nb, vals in ((0, blk & 15), (1, blk >> 4)):
+                t0s = t0_node + vals // lo                            # [8, T]
+                los = vals % lo                                       # [8, T]
+                for k in range(8):
+                    emit(t0s, los, k, 2 * (fg * 8 + k) + nb)
+            return carry
+
+        jax.lax.fori_loop(0, n_pack_groups, pbody, 0)
+    log_off = 16 * n_pack_groups
+
     def body(fg, carry):
         # feature GROUPS of 8: sublane-dim ref slices must be 8-aligned
         # (pl.multiple_of proves it); within a group a static unroll —
@@ -290,30 +399,18 @@ def _accum_hist(bins_ref, out_ref, node, g, h, *, n_nodes, hi, lo, pack):
         # integer prep runs BATCHED on [8, T] (a [1, T] op costs the
         # same VPU tiles as [8, T] — sublane padding), only the one-hot
         # compares are per-feature.
-        base = pl.multiple_of(fg * 8, 8)
+        base = pl.multiple_of(fg * 8 + 8 * n_pack_groups if n_pack_groups
+                              else fg * 8, 8)
         blk = bins_ref[pl.ds(base, 8), :].astype(jnp.int32)           # [8, T]
         # padding rows carry t0_node ≈ -2^20 → t0 < 0 → match nothing
         t0s = t0_node + blk // lo                                     # [8, T]
         los = blk % lo                                                # [8, T]
         for k in range(8):
-            # ONE [nh, T] compare then scale by g and h (the grad/hess
-            # planes share the one-hot) — 2× cheaper than comparing a
-            # [2·nh, T] iota twice.  compare→astype→mul (NOT where):
-            # Mosaic can't relayout an i1 mask against a [1, T]-
-            # replicated where operand.
-            oh = (nh_iota == t0s[k:k + 1]).astype(jnp.bfloat16)       # [Snh, T]
-            lhs = jnp.concatenate([oh * g, oh * h], axis=0)           # [2Snh, T]
-            rhs = (lo_iota == los[k:k + 1]).astype(jnp.bfloat16)      # [lo, T]
-            d = jax.lax.dot_general(
-                lhs, rhs,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                                          # [2Snh, lo]
-            idx = (pl.ds(fg * 8 + k, 1), slice(None), slice(None))
-            out_ref[idx] = out_ref[idx] + d[None]
+            emit(t0s, los, k, log_off + fg * 8 + k if n_pack_groups
+                 else fg * 8 + k)
         return carry
 
-    jax.lax.fori_loop(0, F // 8, body, 0)
+    jax.lax.fori_loop(0, F // 8 - n_pack_groups, body, 0)
 
 
 def _fused_kernel(bins_ref, node_ref, feat_ref, thr_ref, g_ref, h_ref,
@@ -391,14 +488,19 @@ def _lo_factor(n_nodes: int, n_bins: int) -> int:
     return best
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
+@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9))
 def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
                  tile_rows: int = _TILE_ROWS, lo: int = 0,
-                 transposed: bool = False):
+                 transposed: bool = False, layout=None):
     """Pallas TPU path: grid over row tiles, all tiles accumulate into the
     same [F, S·A, lo] VMEM output block (sequential TPU grid ⇒ safe),
     then the S packed subtile slabs sum and one small reshape/transpose
-    yields [2, N, F, B]."""
+    yields [2, N, F, B].
+
+    With a nibble-packed ``layout`` the input is the PHYSICAL matrix:
+    the kernel's packed region emits two logical rows per byte row, the
+    logical output rows are permuted back to STORAGE feature order, and
+    the result is the storage-space histogram [2, N, S, Bs]."""
     if transposed:
         F, n = bins.shape
     else:
@@ -408,6 +510,13 @@ def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
     A = 2 * n_nodes * hi
     S = _pack_factor(n_nodes, n_bins)
     Fp = -(-F // 8) * 8          # feature groups of 8 (sublane alignment)
+    npg = 0
+    if layout is not None:
+        npg = layout.packed_rows // 8          # packed physical groups
+        # logical rows: 2 per packed physical row + the unpacked rest
+        L = 16 * npg + (Fp - 8 * npg)
+    else:
+        L = Fp
     pad = (-n) % tile_rows
     if pad:
         node_id = jnp.pad(node_id, (0, pad), constant_values=-1)
@@ -421,8 +530,9 @@ def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
         bins_t = jnp.pad(bins.T, ((0, Fp - F), (0, pad)))
 
     out = pl.pallas_call(
-        partial(_hist_pallas_kernel, n_nodes=n_nodes, hi=hi, lo=lo, pack=S),
-        out_shape=jax.ShapeDtypeStruct((Fp, S * A, lo), jnp.float32),
+        partial(_hist_pallas_kernel, n_nodes=n_nodes, hi=hi, lo=lo, pack=S,
+                n_pack_groups=npg),
+        out_shape=jax.ShapeDtypeStruct((L, S * A, lo), jnp.float32),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((Fp, tile_rows), lambda i: (0, i)),
@@ -430,10 +540,17 @@ def _hist_pallas(bins, node_id, grad, hess, n_nodes, n_bins,
             pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
             pl.BlockSpec((1, tile_rows), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((Fp, S * A, lo), lambda i: (0, 0, 0)),
+        out_specs=pl.BlockSpec((L, S * A, lo), lambda i: (0, 0, 0)),
         interpret=jax.default_backend() != "tpu",
     )(bins_t, node_id.reshape(1, n_pad), grad.reshape(1, n_pad),
       hess.reshape(1, n_pad))
+    if layout is not None:
+        # kernel-logical rows → storage order (static permutation)
+        perm = _bl.layout_tables(layout)["logical"]
+        out = out.reshape(L, 2, S, n_nodes, hi * lo).sum(axis=2)
+        out = out[jnp.asarray(perm)]
+        out = out.transpose(1, 2, 0, 3)
+        return out[..., :n_bins]
     # [Fp, (gh, S, N, hi), lo] → Σ over S → [gh, N, F, hi·lo] → slice pads
     out = out[:F].reshape(F, 2, S, n_nodes, hi * lo).sum(axis=2)
     out = out.transpose(1, 2, 0, 3)
@@ -505,6 +622,7 @@ def fused_descend_histogram(
     fuse: bool = False,
     dir_sel: jax.Array = None,  # [n] learned missing direction (1=left)
     miss_bin: int = None,       # bin index reserved for NaN rows
+    layout=None,                # BinLayout: bins_t is the physical matrix
 ):
     """Advance rows one level down the tree and build the new level's
     LEFT-child histograms.  Returns ``(left_hist, new_node)`` with
@@ -521,7 +639,7 @@ def fused_descend_histogram(
     rate, binds."""
     F = bins_t.shape[0]
     itemsize = jnp.dtype(bins_t.dtype).itemsize
-    use_pallas = (fuse and dir_sel is None
+    use_pallas = (fuse and dir_sel is None and layout is None
                   and method in ("auto", "pallas")
                   and jax.default_backend() == "tpu"
                   and _pallas_ok(n_bins, F, n_prev, itemsize))
@@ -530,7 +648,7 @@ def fused_descend_histogram(
                              grad, hess, n_prev, n_bins)
     # unfused fallback: XLA descend, then the regular histogram
     valid = node_id >= 0
-    row_bin = select_feature_bins(bins_t, feat_sel)
+    row_bin = select_feature_bins(bins_t, feat_sel, layout=layout)
     go_right = row_bin > thr_sel
     if dir_sel is not None:
         # learned missing direction: NaN rows (bin == miss_bin) follow
@@ -539,19 +657,25 @@ def fused_descend_histogram(
     new_node = jnp.where(valid, 2 * node_id + go_right, -1)
     node_h = jnp.where(valid & (new_node % 2 == 0), new_node >> 1, -1)
     hist = build_histogram(bins_t, node_h, grad, hess, n_prev, n_bins,
-                           method, transposed=True)
+                           method, transposed=True, layout=layout)
     return hist, new_node
 
 
-def select_feature_bins(bins_t: jax.Array, feat_sel: jax.Array) -> jax.Array:
+def select_feature_bins(bins_t: jax.Array, feat_sel: jax.Array,
+                        layout=None) -> jax.Array:
     """``bins_t[feat_sel[r], r]`` for every row r, gather-free.
 
     ``bins_t`` is feature-major [F, n]; a per-row gather over the row
     dimension serializes badly on TPU, so the selected feature's bin is
     extracted by compare-and-sum over the F rows (one [F, n] VPU pass).
     Shared by the tree descend in HistGBT (in-core and external-memory)
-    and the unfused fused_descend_histogram fallback.
+    and the unfused fused_descend_histogram fallback.  With ``layout``
+    the matrix is physical (packed/bundled) and ``feat_sel`` indexes
+    ORIGINAL features — ``binlayout.select_bins`` decodes nibbles and
+    bundle segments after the same compare-and-sum pass.
     """
+    if layout is not None:
+        return _bl.select_bins(bins_t, feat_sel, layout)
     f_iota = jnp.arange(bins_t.shape[0], dtype=jnp.int32)[:, None]
     return jnp.sum(jnp.where(feat_sel[None, :] == f_iota,
                              bins_t.astype(jnp.int32), 0), axis=0)

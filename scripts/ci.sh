@@ -42,6 +42,16 @@ echo "== interleave model check (schedule exploration) =="
 env JAX_PLATFORMS=cpu DMLC_TPU_FORCE_CPU=1 \
     python -m dmlc_core_tpu.analysis.interleave
 
+echo "== histogram kernel drill (cross-method parity + ns/row archive) =="
+# every histogram engine (segment / matmul / pallas-interpret) must be
+# BIT-identical — including through the int4-packed compact-remap layout
+# and through a feature bundle's tot-minus-segments reconstruction — on
+# odd row counts with masked rows; the timed half archives per-method
+# ns/row JSON so kernel regressions land in the artifact chain
+# (doc/performance.md "Packed narrow bins").
+env JAX_PLATFORMS=cpu CHECK_HIST_OUT="${CHECK_HIST_OUT:-/tmp/hist_kernel.json}" \
+    python scripts/check_hist_kernel.py
+
 echo "== api docs =="
 # regenerate doc/api/ + doc/configuration.md (knob table from
 # base/knobs.py) and FAIL on undocumented __all__ exports (SURVEY.md
@@ -63,7 +73,10 @@ echo "== compile cache pre-seed (one warm dir for lanes + bench) =="
 # data), so bench warmup_seconds collapses from the 23-31 s of
 # BENCH_r04/r05 toward the <5 s ROADMAP target and the bench JSON says
 # compile_cache: hit.  Idempotent: a warm rerun joins in cache-read time.
-export DMLC_COMPILE_CACHE_DIR="${DMLC_COMPILE_CACHE_DIR:-${TMPDIR:-/tmp}/dmlc_compile_cache}"
+# The dir MUST default to the library default (~/.cache/...): a bench
+# launched later in a fresh shell carries no env var, so pre-seeding a
+# /tmp dir warms a cache nobody reads (the BENCH_r05 31 s warmup bug).
+export DMLC_COMPILE_CACHE_DIR="${DMLC_COMPILE_CACHE_DIR:-$HOME/.cache/dmlc_core_tpu/xla_compile_cache}"
 mkdir -p "$DMLC_COMPILE_CACHE_DIR"
 python scripts/warm_compile_cache.py
 

@@ -1,0 +1,112 @@
+"""Leaf-wise (lossguide) tree growth: the ISSUE 12 oracle contracts.
+
+The oracle: with an unlimited leaf budget, gain-priority leaf-wise
+expansion visits exactly the set of nodes depth-wise growth splits
+(every split it records has gain > gamma, and expansion order cannot
+change which splits are profitable), and the single-node histogram
+builds are bit-identical to the level-batched ones — so tree STRUCTURE
+(feat/thr arrays) must match depth-wise exactly.  Leaf values may
+differ at last-ulp in UNREACHABLE leaves: depth-wise materializes a
+degenerate right-subtraction chain under pruned nodes (hist − hist of
+identical row sets is not exactly 0 after the parent was itself
+subtracted), where lossguide leaves a clean −0.0; no rows reach those
+leaves, so predictions agree to float tolerance.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_core_tpu.base.logging import Error  # noqa: E402
+from dmlc_core_tpu.models import HistGBT  # noqa: E402
+from dmlc_core_tpu.ops.histogram import leaves_built_per_round  # noqa: E402
+
+KW = dict(n_trees=4, max_depth=4, n_bins=32,
+          objective="binary:logistic", learning_rate=0.3)
+
+
+def _xy(n=2003, F=7, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    X[:, 2] = rng.integers(0, 3, n)
+    y = ((X[:, 0] + 0.5 * X[:, 2] - X[:, 1] * X[:, 3]) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+class TestLossguideOracle:
+    def test_unlimited_budget_matches_depthwise(self, monkeypatch):
+        X, y = _xy()
+        m0 = HistGBT(**KW)
+        m0.fit(X, y)
+        monkeypatch.setenv("DMLC_GROW_POLICY", "lossguide")
+        m1 = HistGBT(**KW)
+        m1.fit(X, y)
+        for i, (t0, t1) in enumerate(zip(m0.trees, m1.trees)):
+            assert np.array_equal(t0["feat"], t1["feat"]), i
+            assert np.array_equal(t0["thr"], t1["thr"]), i
+            np.testing.assert_allclose(t0["gain"], t1["gain"],
+                                       rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(t0["leaf"], t1["leaf"],
+                                       rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(m0.predict(X), m1.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_max_leaves_budget_respected(self, monkeypatch):
+        X, y = _xy(seed=1)
+        monkeypatch.setenv("DMLC_GROW_POLICY", "lossguide")
+        monkeypatch.setenv("DMLC_MAX_LEAVES", "6")
+        m = HistGBT(**KW)
+        m.fit(X, y)
+        for t in m.trees:
+            # ≤ max_leaves − 1 realized splits per tree (gain > 0 only
+            # where a split was recorded; degenerate nodes record 0)
+            assert int((np.asarray(t["gain"]) > 0).sum()) <= 5
+        acc = ((m.predict(X) > 0.5) == y).mean()
+        assert acc > 0.8
+
+    def test_default_policy_is_depthwise_byte_parity(self, tmp_path,
+                                                     monkeypatch):
+        X, y = _xy(seed=2)
+        m0 = HistGBT(**KW)
+        m0.fit(X, y)
+        monkeypatch.setenv("DMLC_GROW_POLICY", "depthwise")
+        m1 = HistGBT(**KW)
+        m1.fit(X, y)
+        u0, u1 = str(tmp_path / "a.ubj"), str(tmp_path / "b.ubj")
+        m0.save_model(u0)
+        m1.save_model(u1)
+        assert open(u0, "rb").read() == open(u1, "rb").read()
+
+    def test_invalid_policy_rejected(self, monkeypatch):
+        X, y = _xy(n=203)
+        monkeypatch.setenv("DMLC_GROW_POLICY", "bogus")
+        with pytest.raises(Error):
+            HistGBT(**KW).fit(X, y)
+
+    def test_packed_lossguide_structure(self, monkeypatch):
+        # both levers together: packed storage + leaf-wise growth
+        X, y = _xy(seed=3)
+        m0 = HistGBT(**KW)
+        m0.fit(X, y)
+        monkeypatch.setenv("DMLC_GROW_POLICY", "lossguide")
+        monkeypatch.setenv("DMLC_BIN_PACK", "1")
+        m1 = HistGBT(**KW)
+        m1.fit(X, y)
+        for t0, t1 in zip(m0.trees, m1.trees):
+            assert np.array_equal(t0["feat"], t1["feat"])
+            assert np.array_equal(t0["thr"], t1["thr"])
+
+
+class TestLeavesAccounting:
+    def test_leaves_built_per_round(self):
+        # depth-wise: root + left children only (sibling subtraction)
+        assert leaves_built_per_round(1) == 1
+        assert leaves_built_per_round(6) == 32
+        # lossguide: one build per expansion, depth-independent
+        assert leaves_built_per_round(6, "lossguide", 8) == 8
+        assert leaves_built_per_round(6, "lossguide", 0) == 64

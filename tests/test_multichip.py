@@ -233,6 +233,30 @@ class TestOneChipOracle:
         m2.fit(X, y, cuts=cuts)
         assert _trees_equal(m1.trees, m2.trees)
 
+    def test_nchip_oracle_survives_new_knobs(self, monkeypatch):
+        # the ISSUE 12 levers must preserve the mesh-shape-invariant
+        # fold: packed storage derives the SAME layout on every mesh
+        # (occupancy counts are row-order independent) and lossguide
+        # mirrors the per-block deterministic reduction
+        monkeypatch.setenv("DMLC_HIST_BLOCKS", "8")
+        monkeypatch.setenv("DMLC_BIN_PACK", "1")
+        monkeypatch.setenv("DMLC_GROW_POLICY", "lossguide")
+        rng = np.random.default_rng(5)
+        n = 1003
+        X = rng.normal(size=(n, 7)).astype(np.float32)
+        X[:, 2] = rng.integers(0, 3, n).astype(np.float32)
+        X[:, 5] = rng.integers(0, 4, n).astype(np.float32)
+        y = (X[:, 0] + X[:, 2] > 0.5).astype(np.float32)
+        cuts = compute_cuts(X, KW["n_bins"])
+        devs = np.array(jax.devices())
+        m1 = HistGBT(mesh=Mesh(devs[:1], ("data",)), **KW)
+        m1.fit(X, y, cuts=cuts)
+        m8 = HistGBT(mesh=Mesh(devs[:8], ("data",)), **KW)
+        m8.fit(X, y, cuts=cuts)
+        assert m1._bin_layout is not None
+        assert m1._bin_layout == m8._bin_layout   # identical layout
+        assert _trees_equal(m1.trees, m8.trees)
+
     def test_deterministic_mode_prediction_parity(self, monkeypatch):
         # deterministic-mode trees predict identically from either mesh
         monkeypatch.setenv("DMLC_HIST_BLOCKS", "8")
@@ -336,6 +360,62 @@ class TestPsumTraffic:
         expect = KW["n_trees"] * hist_psum_bytes_per_round(
             KW["max_depth"], X.shape[1], KW["n_bins"])
         assert psum_total() - before == expect
+
+    def test_counter_matches_model_packed(self, monkeypatch):
+        # packed layout: the analytic model (and therefore the counter)
+        # must price the STORAGE shape the psum actually syncs
+        from dmlc_core_tpu.base.metrics import default_registry
+
+        rng = np.random.default_rng(21)
+        n, F = 512, 6
+        X = rng.normal(size=(n, F)).astype(np.float32)
+        X[:, 1] = rng.integers(0, 3, n).astype(np.float32)
+        X[:, 3] = rng.integers(0, 2, n).astype(np.float32)
+        X[:, 4] = rng.integers(0, 4, n).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+
+        def psum_total():
+            snap = default_registry().snapshot()["metrics"]
+            m = snap.get("dmlc_histogram_psum_bytes_total")
+            return (sum(s["value"] for s in m["series"]
+                        if s["labels"].get("engine") == "incore")
+                    if m else 0.0)
+
+        monkeypatch.setenv("DMLC_BIN_PACK", "1")
+        before = psum_total()
+        m8 = HistGBT(mesh=local_mesh(8), **KW)
+        m8.fit(X, y)
+        assert m8._bin_layout is not None      # the lever actually fired
+        expect = KW["n_trees"] * hist_psum_bytes_per_round(
+            KW["max_depth"], F, KW["n_bins"], layout=m8._bin_layout)
+        assert psum_total() - before == expect
+
+    def test_counter_matches_model_lossguide(self, monkeypatch):
+        from dmlc_core_tpu.base.metrics import default_registry
+
+        X, y = _make_xy(512, seed=14)
+
+        def psum_total():
+            snap = default_registry().snapshot()["metrics"]
+            m = snap.get("dmlc_histogram_psum_bytes_total")
+            return (sum(s["value"] for s in m["series"]
+                        if s["labels"].get("engine") == "incore")
+                    if m else 0.0)
+
+        monkeypatch.setenv("DMLC_GROW_POLICY", "lossguide")
+        monkeypatch.setenv("DMLC_MAX_LEAVES", "4")
+        before = psum_total()
+        m8 = HistGBT(mesh=local_mesh(8), **KW)
+        m8.fit(X, y)
+        expect = KW["n_trees"] * hist_psum_bytes_per_round(
+            KW["max_depth"], X.shape[1], KW["n_bins"],
+            grow_policy="lossguide", max_leaves=4)
+        assert psum_total() - before == expect
+        # the lever's win shows at depth: a budgeted deep tree syncs
+        # far fewer built nodes than level-batched growth
+        assert hist_psum_bytes_per_round(
+            6, 28, 256, grow_policy="lossguide", max_leaves=8
+        ) < hist_psum_bytes_per_round(6, 28, 256)
 
     def test_counter_silent_on_one_chip(self):
         from dmlc_core_tpu.base.metrics import default_registry
