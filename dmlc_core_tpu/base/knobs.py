@@ -341,3 +341,31 @@ declare("DMLC_TRACKER_GRACE_S", 0.0,
 declare("DMLC_KVSTORE_CHECK", 0,
         "1 enables out-of-mesh KVStore consistency checks (debug).",
         "distributed")
+
+# -- parameter server -------------------------------------------------------
+declare("DMLC_PS_STALENESS", 4,
+        "Bounded-staleness window tau for dist_async pulls: a pull at "
+        "worker clock c blocks until every worker committed c - tau; "
+        "0 = BSP, negative = fully async (never block).", "ps")
+declare("DMLC_PS_PIPELINE", 8,
+        "In-flight request window per server connection: async pushes "
+        "beyond this many unacked requests block the sender.", "ps")
+declare("DMLC_PS_PULL_TIMEOUT_S", 60.0,
+        "Seconds a pull may wait on the server-side staleness gate "
+        "before erroring out.", "ps")
+declare("DMLC_PS_RECONNECT_S", 30.0,
+        "Deadline in seconds for re-resolving and re-dialing a lost "
+        "server connection (respawn failover window).", "ps")
+declare("DMLC_PS_SNAPSHOT_DIR", "",
+        "Directory for per-server shard snapshots (atomic CRC'd "
+        "checkpoints); empty disables durability.", "ps")
+declare("DMLC_PS_SNAPSHOT_STRIDE", 0,
+        "Committed clock ticks between shard snapshots; 0 disables "
+        "periodic snapshots.", "ps")
+declare("DMLC_PS_SERVER_ID", -1,
+        "Server shard id for DMLC_ROLE=server processes; -1 lets the "
+        "scheduler assign the next free id (a respawn passes its old "
+        "id to reclaim the shard).", "ps")
+declare("DMLC_PS_SERVER_URI", "127.0.0.1",
+        "Host/interface a DMLC_ROLE=server process binds its data "
+        "plane to (advertised to the scheduler).", "ps")

@@ -351,6 +351,80 @@ class GBLinear:
         w_d = jax.device_put(w, sh_r)
         return self._fit_device(x_d, y_d, w_d, F, warmup_rounds)
 
+    def fit_ps(self, row_iter, kv, num_col: Optional[int] = None,
+               batch_rows: int = 8192, n_epochs: int = 1,
+               name: str = "gblinear", finalize: bool = True
+               ) -> "GBLinear":
+        """Web-scale sparse SGD over a parameter server.
+
+        The complement of :meth:`fit_iter` for feature spaces that do
+        NOT fit a dense device matrix (10M+-cardinality CTR hashing
+        spaces): weights live range-sharded on the PS fleet behind
+        ``kv`` (a dist_async :class:`~..parallel.kvstore.KVStore`);
+        each CSR minibatch pulls only the feature ids it touches,
+        computes the (mean-loss) gradient on the host straight off the
+        ``offset``/``index``/``value`` arrays, and pushes it back
+        asynchronously — the server applies SGD with the store's
+        learning_rate on arrival.  One :meth:`tick` per minibatch is
+        the SSP round; staleness across workers is bounded by
+        ``DMLC_PS_STALENESS``.
+
+        ``reg_lambda`` is applied lazily (touched coordinates only),
+        scaled 1/n alongside the data term — the sum-loss
+        ``Σ lᵢ + λ/2‖w‖²`` divided by batch size, the standard sparse
+        compromise (untouched features decay only when next seen).
+
+        ``finalize`` pulls the full dense weight vector into
+        ``self.weights`` / ``self.bias`` at the end so
+        :meth:`predict` works; pass False at true 10M+ scale and
+        serve from the fleet instead.
+        """
+        p = self.param
+        F = max(num_col or 0, getattr(row_iter, "num_col", 0) or 0)
+        CHECK(F > 0, "fit_ps: no columns (num_col unset and the "
+                     "iterator reports width 0)")
+        from dmlc_core_tpu.data.iter import iter_csr_minibatches
+
+        # bias rides at id F: one PS array, one pull per minibatch
+        kv.init_sparse(name, n_keys=F + 1)
+        logistic = p.objective == "binary:logistic"
+        lam = p.reg_lambda
+        t0 = get_time()
+        for _ in range(int(n_epochs)):
+            for blk in iter_csr_minibatches(row_iter, batch_rows):
+                n = blk.size
+                vals = (blk.value if blk.value is not None
+                        else np.ones(blk.nnz, np.float32))
+                uids, inv = np.unique(blk.index, return_inverse=True)
+                ids = np.concatenate([uids, [F]])
+                w = np.asarray(kv.pull_sparse(name, ids), np.float32)
+                rows = np.repeat(np.arange(n),
+                                 np.diff(blk.offset)).astype(np.int64)
+                margin = np.full(n, w[-1] + p.base_score, np.float32)
+                np.add.at(margin, rows, w[:-1][inv] * vals)
+                y = blk.label
+                if logistic:
+                    g = 1.0 / (1.0 + np.exp(-margin)) - y
+                else:
+                    g = margin - y
+                sw = self._fold_scale_pos_weight(y, blk.weight)
+                if sw is not None:
+                    g = g * sw
+                gfeat = np.zeros(len(uids), np.float32)
+                np.add.at(gfeat, inv, g[rows] * vals)
+                grad = np.concatenate([gfeat + lam * w[:-1],
+                                       [g.sum()]]) / n
+                kv.push_sparse(name, ids, grad.astype(np.float32))
+                kv.tick()
+        kv.flush()
+        self.last_fit_seconds = get_time() - t0
+        if finalize:
+            ids = np.arange(F + 1, dtype=np.int64)
+            w = np.asarray(kv.pull_sparse(name, ids), np.float32)
+            self.weights = w[:-1]
+            self.bias = float(w[-1]) + p.base_score
+        return self
+
     # -- inference ------------------------------------------------------
     def predict(self, X: np.ndarray,
                 output_margin: bool = False) -> np.ndarray:

@@ -511,15 +511,42 @@ class PSTracker:
     """Parameter-server role bootstrap.
 
     Reference parity: ``tracker.py :: PSTracker`` — exports
-    ``DMLC_PS_ROOT_URI/PORT`` and role env vars.  The actual PS engine is
-    replaced by the KVStore shim over XLA collectives
-    (``dmlc_core_tpu.parallel.kvstore``), so this only serves the ABI.
+    ``DMLC_PS_ROOT_URI/PORT`` and role env vars.  Historically this
+    only served the ABI (the engine was the KVStore shim over XLA
+    collectives); with ``parallel/ps`` the scheduler is real:
+    :meth:`start` hosts a
+    :class:`~dmlc_core_tpu.parallel.ps.PSScheduler` on this tracker's
+    host/port, so processes launched with these envs and
+    ``KVStore.create("dist_async")`` form a working
+    scheduler/server/worker triad.
     """
 
     def __init__(self, host_ip: str = "127.0.0.1", port: int = 9092,
                  nworker: int = 1, nserver: int = 0):
         self.host_ip, self.port = host_ip, port
         self.nworker, self.nserver = nworker, nserver
+        self._scheduler: Optional[Any] = None
+
+    def start(self) -> None:
+        """Host the PS scheduler in-process (port 0 binds a free port
+        and updates ``self.port`` so the env ABI advertises it)."""
+        from dmlc_core_tpu.parallel.ps import PSScheduler
+
+        self._scheduler = PSScheduler(
+            host_ip=self.host_ip, port=self.port,
+            nworker=self.nworker, nserver=max(1, self.nserver))
+        self._scheduler.start()
+        self.port = self._scheduler.port
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every worker's shutdown (True when all arrived)."""
+        CHECK(self._scheduler is not None, "PSTracker.join before start")
+        return self._scheduler.join(timeout)
+
+    def stop(self) -> None:
+        if self._scheduler is not None:
+            self._scheduler.stop()
+            self._scheduler = None
 
     def slave_envs(self) -> Dict[str, str]:
         return {
@@ -545,7 +572,7 @@ def submit(
     fun_submit: Callable[[int, Dict[str, str]], Any],
     host_ip: str = "127.0.0.1",
     start_tracker: bool = False,
-) -> Optional[RabitTracker]:
+) -> Optional[Any]:
     """Launch-glue.  Reference parity: ``tracker.py :: submit``.
 
     Picks rabit vs PS mode (``nserver == 0`` → rabit, like the reference),
@@ -556,14 +583,17 @@ def submit(
     ``DMLC_TRACKER_URI:PORT`` (process 0 hosts the service), so the
     RabitTracker TCP service is only started when ``start_tracker=True``
     (legacy workers); it then runs on its *own* port, exported as
-    ``DMLC_LEGACY_TRACKER_PORT``.
+    ``DMLC_LEGACY_TRACKER_PORT``.  In PS mode ``start_tracker=True``
+    hosts the real PS scheduler in-process (``parallel/ps``) on a free
+    port and returns the :class:`PSTracker` — launched processes bind
+    their roles through ``KVStore.create("dist_async")``.
     """
     CHECK(nworker >= 1, "need at least one worker")
     envs: Dict[str, str] = {
         "DMLC_NUM_WORKER": str(nworker),
         "DMLC_NUM_SERVER": str(nserver),
     }
-    tracker: Optional[RabitTracker] = None
+    tracker: Optional[Any] = None
     if nserver == 0:
         envs["DMLC_TRACKER_URI"] = host_ip
         envs["DMLC_TRACKER_PORT"] = str(_free_port(host_ip))
@@ -573,6 +603,10 @@ def submit(
             envs["DMLC_LEGACY_TRACKER_PORT"] = str(tracker.port)
     else:
         ps = PSTracker(host_ip=host_ip, nworker=nworker, nserver=nserver)
+        if start_tracker:
+            ps.port = 0                  # bind a free port, not the ABI
+            ps.start()                   # default; start() updates .port
+            tracker = ps
         envs.update(ps.slave_envs())
         envs["DMLC_TRACKER_URI"] = host_ip
         envs["DMLC_TRACKER_PORT"] = str(_free_port(host_ip))

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Parameter-server chaos drill for CI: SIGKILL a server mid-epoch.
+
+A real multi-process PS job — scheduler (parent-hosted) + 2 server +
+3 worker PROCESSES — trains sparse GBLinear over the dist_async
+KVStore, twice:
+
+1. **Baseline** — uninterrupted: every worker converges (train
+   accuracy on its own shard above the floor) and exits clean.
+2. **Kill/restore** — server 1 runs under the deterministic
+   ``ps_push:kill`` fault and SIGKILLs itself mid-epoch.  Workers'
+   pushes to that shard fail over (re-resolve via the scheduler inside
+   ``DMLC_PS_RECONNECT_S``); the parent respawns the SAME server id
+   pointed at the SAME ``DMLC_PS_SNAPSHOT_DIR``, which restores the
+   shard from the newest atomic snapshot (vector clock included) and
+   picks the job back up.  The lost tail between snapshot and kill is
+   bounded by snapshot stride + staleness; the drill asserts every
+   worker still converges within tolerance of the baseline and that
+   the respawned server reports a restore
+   (``dmlc_ps_server_restores_total``).
+
+Every process runs under ``DMLC_LOCKCHECK=1`` + ``DMLC_RACECHECK=1``
+and verifies zero lock-order cycles; the parent additionally asserts
+zero happens-before races and archives the report to
+``PS_RACECHECK_OUT`` (default ``/tmp/ps_racecheck.json``).
+
+Exit 0 = both phases green.  Usage:
+    python scripts/check_ps.py             # run the drill
+    python scripts/check_ps.py --server    # (internal server entry)
+    python scripts/check_ps.py --worker    # (internal worker entry)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SERVERS = 2
+N_WORKERS = 3
+N_FEATURES = 50_000
+ROWS_PER_WORKER = 6_000
+NNZ = 16
+BATCH_ROWS = 256
+EPOCHS = 3
+ACC_FLOOR = 0.80          # baseline convergence floor
+ACC_TOLERANCE = 0.06      # kill-phase accuracy may trail baseline by this
+
+
+def _shard_blocks(rank):
+    """Deterministic per-worker CSR shard: 64 signal features out of
+    50k, shared across ranks so every shard is learnable."""
+    import numpy as np
+
+    from dmlc_core_tpu.data.row_block import RowBlock
+
+    sig_rng = np.random.default_rng(7)
+    hot = sig_rng.choice(N_FEATURES, 64, replace=False)
+    w_true = sig_rng.normal(size=64).astype(np.float32)
+    rng = np.random.default_rng(100 + rank)
+    blocks = []
+    for _ in range(4):
+        n = ROWS_PER_WORKER // 4
+        idx = rng.integers(0, N_FEATURES, size=(n, NNZ)).astype(np.int64)
+        idx[:, :4] = hot[rng.integers(0, 64, size=(n, 4))]
+        vals = rng.normal(size=(n, NNZ)).astype(np.float32)
+        order = np.argsort(hot)
+        pos = order[np.searchsorted(hot[order], idx[:, :4])]
+        y = ((vals[:, :4] * w_true[pos]).sum(1) > 0).astype(np.float32)
+        off = np.arange(0, n * NNZ + 1, NNZ, dtype=np.int64)
+        blocks.append(RowBlock(offset=off, label=y, index=idx.ravel(),
+                               value=vals.ravel()))
+    return blocks
+
+
+# ---------------------------------------------------------------------------
+# subprocess entries
+# ---------------------------------------------------------------------------
+
+def server_main() -> None:
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.parallel.ps import PSServer
+
+    port = int(os.environ["PS_SCHED_PORT"])
+    srv = PSServer("127.0.0.1", port,
+                   server_id=int(os.environ["DMLC_PS_SERVER_ID"]))
+    srv.start()
+    srv.serve_forever(timeout_s=600)
+    out = os.environ.get("PS_SERVER_STATS")
+    if out:
+        with open(out, "w") as f:
+            json.dump({"server_id": srv.server_id,
+                       "restored_version": srv.restored_version}, f)
+    lockcheck.check()   # zero lock-order cycles, or die loudly
+
+
+def worker_main() -> None:
+    import numpy as np
+
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.models.linear import GBLinear
+    from dmlc_core_tpu.parallel.kvstore import DistAsyncKVStore
+    from dmlc_core_tpu.parallel.ps import PSClient
+
+    rank = int(os.environ["DMLC_TASK_ID"])
+    port = int(os.environ["PS_SCHED_PORT"])
+    client = PSClient(root_uri="127.0.0.1", root_port=port, rank=rank)
+    kv = DistAsyncKVStore(client, learning_rate=0.5)
+    blocks = _shard_blocks(rank)
+    model = GBLinear(learning_rate=0.5, reg_lambda=0.0)
+    model.fit_ps(blocks, kv, num_col=N_FEATURES,
+                 batch_rows=BATCH_ROWS, n_epochs=EPOCHS)
+    # convergence: train accuracy on this worker's own shard
+    correct = total = 0
+    for blk in blocks:
+        rows = np.repeat(np.arange(blk.size), np.diff(blk.offset))
+        m = np.zeros(blk.size, np.float32)
+        np.add.at(m, rows, model.weights[blk.index] * blk.value)
+        m += model.bias
+        correct += int(((m > 0) == (blk.label > 0.5)).sum())
+        total += blk.size
+    samples = kv.staleness_samples
+    with open(os.path.join(os.environ["PS_OUT"],
+                           f"worker-{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "accuracy": correct / total,
+                   "staleness_max": max(samples) if samples else 0,
+                   "pull_rounds": len(samples)}, f)
+    kv.close(shutdown_job=False)    # parent owns the scheduler
+    lockcheck.check()
+
+
+# ---------------------------------------------------------------------------
+# parent: supervise phases
+# ---------------------------------------------------------------------------
+
+def _check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def _launch(role, port, out_dir, snap_dir, server_id=-1, rank=-1,
+            fault="", stats=""):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               DMLC_TPU_FORCE_CPU="1",
+               DMLC_LOCKCHECK="1",
+               DMLC_RACECHECK="1",
+               DMLC_FAULT_INJECT=fault,
+               DMLC_PS_SNAPSHOT_DIR=snap_dir,
+               DMLC_PS_SNAPSHOT_STRIDE="1",
+               DMLC_PS_RECONNECT_S="120",
+               DMLC_PS_SERVER_ID=str(server_id),
+               DMLC_TASK_ID=str(rank),
+               PS_SCHED_PORT=str(port),
+               PS_OUT=out_dir,
+               PS_SERVER_STATS=stats)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), f"--{role}"], env=env)
+
+
+def _wait(procs, timeout_s, label):
+    deadline = time.time() + timeout_s
+    for p in procs:
+        left = max(1.0, deadline - time.time())
+        try:
+            p.wait(timeout=left)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            _check(False, f"{label}: pid {p.pid} hung")
+
+
+def _worker_stats(out_dir):
+    out = {}
+    for rank in range(N_WORKERS):
+        path = os.path.join(out_dir, f"worker-{rank}.json")
+        with open(path) as f:
+            out[rank] = json.load(f)
+    return out
+
+
+def _run_phase(label, tmp, fault_sid=None, fault=""):
+    """One full PS job; returns per-worker stats + respawn stats."""
+    from dmlc_core_tpu.parallel.ps import PSScheduler
+
+    out_dir = os.path.join(tmp, label)
+    snap_dir = os.path.join(tmp, f"{label}-snap")
+    os.makedirs(out_dir)
+    os.makedirs(snap_dir)
+    sched = PSScheduler("127.0.0.1", nworker=N_WORKERS, nserver=N_SERVERS)
+    sched.start()
+    servers = [
+        _launch("server", sched.port, out_dir, snap_dir, server_id=i,
+                fault=fault if i == fault_sid else "")
+        for i in range(N_SERVERS)]
+    workers = [_launch("worker", sched.port, out_dir, snap_dir, rank=r)
+               for r in range(N_WORKERS)]
+
+    respawn_stats = None
+    if fault_sid is not None:
+        victim = servers[fault_sid]
+        try:
+            victim.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            _check(False, f"{label}: victim server never died")
+        _check(victim.returncode == -signal.SIGKILL,
+               f"{label}: server {fault_sid} SIGKILLed mid-epoch "
+               f"(rc={victim.returncode})")
+        stats_path = os.path.join(out_dir, "respawn.json")
+        replacement = _launch("server", sched.port, out_dir, snap_dir,
+                              server_id=fault_sid, stats=stats_path)
+        servers = ([s for s in servers if s is not victim]
+                   + [replacement])
+        _wait(workers + servers, 600, label)
+        with open(stats_path) as f:
+            respawn_stats = json.load(f)
+    else:
+        _wait(workers + servers, 600, label)
+
+    _check(all(p.returncode == 0 for p in workers),
+           f"{label}: all {N_WORKERS} workers exited clean "
+           f"({[p.returncode for p in workers]})")
+    _check(all(p.returncode == 0 for p in servers),
+           f"{label}: surviving servers exited clean "
+           f"({[p.returncode for p in servers]})")
+    sched.stop()
+    return _worker_stats(out_dir), respawn_stats
+
+
+def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--server":
+        server_main()
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        worker_main()
+        return
+
+    os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    os.environ.setdefault("DMLC_RACECHECK", "1")
+    from dmlc_core_tpu.base import lockcheck, racecheck
+
+    tmp = tempfile.mkdtemp(prefix="dmlc_ps_drill")
+    staleness_bound = int(os.environ.get("DMLC_PS_STALENESS", 4))
+
+    # -- phase 1: uninterrupted baseline --------------------------------
+    base, _ = _run_phase("baseline", tmp)
+    for rank, st in base.items():
+        _check(st["accuracy"] >= ACC_FLOOR,
+               f"baseline: worker {rank} converged "
+               f"(acc {st['accuracy']:.3f} >= {ACC_FLOOR})")
+        _check(st["staleness_max"] <= staleness_bound,
+               f"baseline: worker {rank} staleness "
+               f"{st['staleness_max']} <= bound {staleness_bound}")
+
+    # -- phase 2: SIGKILL server 1 mid-epoch, respawn + restore ---------
+    kill, respawn = _run_phase("kill", tmp, fault_sid=1,
+                               fault="ps_push:kill:after=40")
+    _check(respawn is not None and respawn["server_id"] == 1,
+           "kill: replacement came back as server 1")
+    _check(respawn["restored_version"] >= 1,
+           f"kill: replacement restored snapshot "
+           f"v{respawn['restored_version']} "
+           "(dmlc_ps_server_restores_total >= 1)")
+    for rank, st in kill.items():
+        floor = base[rank]["accuracy"] - ACC_TOLERANCE
+        _check(st["accuracy"] >= floor,
+               f"kill: worker {rank} reconverged through the restore "
+               f"(acc {st['accuracy']:.3f} >= baseline - tol {floor:.3f})")
+        _check(st["staleness_max"] <= staleness_bound,
+               f"kill: worker {rank} staleness {st['staleness_max']} "
+               f"<= bound {staleness_bound}")
+
+    lockcheck.check()
+    print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    rc_out = os.environ.get("PS_RACECHECK_OUT", "/tmp/ps_racecheck.json")
+    racecheck.write_report(rc_out)
+    racecheck.check()
+    print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
+          f"(parent; report at {rc_out})")
+    print("PS CHAOS DRILL GREEN")
+
+
+if __name__ == "__main__":
+    main()

@@ -145,6 +145,21 @@ env JAX_PLATFORMS=cpu \
     FLEET_RACECHECK_OUT="${FLEET_RACECHECK_OUT:-/tmp/fleet_racecheck.json}" \
     python scripts/check_fleet.py
 
+echo "== parameter-server chaos drill (kill server / respawn / restore) =="
+# scheduler + 2 server + 3 worker processes training sparse GBLinear
+# over the dist_async KVStore; server 1 is SIGKILLed mid-epoch by the
+# deterministic ps_push fault.  Workers fail over through the
+# scheduler, the parent respawns the same server id against the same
+# DMLC_PS_SNAPSHOT_DIR, and the shard restores from the atomic
+# snapshot (vector clock included) — every worker must reconverge
+# within tolerance of the uninterrupted baseline and SSP staleness
+# must stay within DMLC_PS_STALENESS.  All processes run under
+# DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1 with zero order cycles and zero
+# happens-before races (doc/distributed.md "Parameter server").
+env JAX_PLATFORMS=cpu \
+    PS_RACECHECK_OUT="${PS_RACECHECK_OUT:-/tmp/ps_racecheck.json}" \
+    python scripts/check_ps.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

@@ -1,0 +1,360 @@
+"""Tests for the sharded parameter server (parallel/ps/): partition
+math properties, wire framing, the dist_sync fused pull + batched-init
+satellites, and the in-process scheduler/server/client triad behind
+the dist_async KVStore."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base.logging import Error
+from dmlc_core_tpu.parallel.kvstore import DistAsyncKVStore, KVStore
+from dmlc_core_tpu.parallel.mesh import local_mesh
+from dmlc_core_tpu.parallel.ps import (
+    PSClient,
+    PSScheduler,
+    PSServer,
+    rebalance_plan,
+    route_hashed,
+    server_of,
+    server_ranges,
+    split_by_server,
+)
+from dmlc_core_tpu.parallel.ps import wire
+
+
+# ---------------------------------------------------------------------------
+# partition properties (satellite: property tests)
+# ---------------------------------------------------------------------------
+
+class TestPartition:
+    @pytest.mark.parametrize("n_keys", [0, 1, 7, 100, 10_007])
+    @pytest.mark.parametrize("nservers", [1, 2, 3, 5, 7, 13])
+    def test_ranges_tile_exactly(self, n_keys, nservers):
+        """Contiguous, gap-free, and balanced to ±1 — for EVERY count,
+        including odd ones that don't divide n_keys."""
+        ranges = server_ranges(n_keys, nservers)
+        assert len(ranges) == nservers
+        assert ranges[0][0] == 0
+        assert ranges[-1][1] == n_keys
+        sizes = []
+        for (lo, hi), (lo2, _hi2) in zip(ranges, ranges[1:]):
+            assert hi == lo2            # gap-free
+            assert lo <= hi
+            sizes.append(hi - lo)
+        sizes.append(ranges[-1][1] - ranges[-1][0])
+        assert max(sizes) - min(sizes) <= 1
+
+    @pytest.mark.parametrize("n_keys,nservers", [(100, 3), (7, 5), (64, 8)])
+    def test_server_of_matches_ranges(self, n_keys, nservers):
+        ranges = server_ranges(n_keys, nservers)
+        ids = np.arange(n_keys, dtype=np.int64)
+        owner = server_of(ids, n_keys, nservers)
+        for k, (lo, hi) in enumerate(ranges):
+            np.testing.assert_array_equal(owner[lo:hi], k)
+
+    def test_split_by_server_partitions_positions(self):
+        n_keys, nservers = 1000, 7
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, n_keys, size=500).astype(np.int64)
+        parts = split_by_server(ids, n_keys, nservers)
+        seen = np.concatenate([pos for pos in parts.values()])
+        # every position exactly once, and routed to its range owner
+        assert sorted(seen.tolist()) == list(range(len(ids)))
+        for sid, pos in parts.items():
+            lo, hi = server_ranges(n_keys, nservers)[sid]
+            assert ((ids[pos] >= lo) & (ids[pos] < hi)).all()
+
+    @pytest.mark.parametrize("old,new", [(3, 5), (5, 3), (1, 7), (4, 4),
+                                         (2, 9)])
+    def test_rebalance_preserves_every_key(self, old, new):
+        """Replaying the move plan over per-key ownership must land
+        every key exactly where the new tiling says, losing none."""
+        n_keys = 101
+        owner = np.empty(n_keys, np.int64)
+        for k, (lo, hi) in enumerate(server_ranges(n_keys, old)):
+            owner[lo:hi] = k
+        for src, dst, lo, hi in rebalance_plan(n_keys, old, new):
+            assert (owner[lo:hi] == src).all()      # moves come from src
+            owner[lo:hi] = dst
+        for k, (lo, hi) in enumerate(server_ranges(n_keys, new)):
+            np.testing.assert_array_equal(owner[lo:hi], k)
+
+    def test_rebalance_same_count_is_empty(self):
+        assert rebalance_plan(1000, 4, 4) == []
+
+    def test_route_hashed_stable_and_balanced(self):
+        ids = np.arange(100_000, dtype=np.int64)
+        a = route_hashed(ids, 7)
+        b = route_hashed(ids.copy(), 7)
+        np.testing.assert_array_equal(a, b)          # deterministic
+        assert a.min() >= 0 and a.max() < 7
+        counts = np.bincount(a, minlength=7)
+        # multiplicative hash on uniform ids: within 10% of even
+        assert counts.min() > 0.9 * ids.size / 7
+        assert counts.max() < 1.1 * ids.size / 7
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_round_trip_mixed_dtypes(self):
+        a, b = socket.socketpair()
+        try:
+            fa, fb = a.makefile("rwb"), b.makefile("rwb")
+            arrays = [np.arange(5, dtype=np.int64),
+                      np.zeros((2, 3), np.float32),
+                      np.array([1.5], np.float64)]
+            wire.send_msg(fa, {"cmd": "x", "k": 1}, arrays)
+            header, out = wire.recv_msg(fb)
+            assert header == {"cmd": "x", "k": 1}
+            assert len(out) == len(arrays)
+            for got, want in zip(out, arrays):
+                assert got.dtype == want.dtype
+                assert got.shape == want.shape
+                np.testing.assert_array_equal(got, want)
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_raises_connection_error(self):
+        a, b = socket.socketpair()
+        fa = a.makefile("rwb")
+        b.close()
+        a.shutdown(socket.SHUT_RD)
+        with pytest.raises(ConnectionError):
+            wire.recv_msg(fa)
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# dist_sync satellites: batched init broadcast + fused pull identity
+# ---------------------------------------------------------------------------
+
+class TestDistSyncSatellites:
+    def test_multi_key_init_single_broadcast(self, monkeypatch):
+        """Initializing a whole list of keys must cost ONE broadcast,
+        not one per key — and round-trip values/dtypes exactly."""
+        from dmlc_core_tpu.parallel import kvstore as kvmod
+
+        calls = []
+        real = kvmod.coll.broadcast
+
+        def counting_broadcast(x, root=0):
+            calls.append(np.asarray(x).nbytes)
+            return real(x, root)
+
+        monkeypatch.setattr(kvmod.coll, "broadcast", counting_broadcast)
+        kv = KVStore("dist_sync")
+        # dtypes that survive jnp canonicalization (f64 would downcast)
+        vals = [np.arange(6, dtype=np.float32),
+                np.ones((2, 4), np.float32) * 1.5,
+                np.array([7, 8, 9], np.int32)]
+        kv.init(["a", "b", "c"], vals)
+        assert len(calls) == 1
+        for k, want in zip(["a", "b", "c"], vals):
+            got = np.asarray(kv.pull(k))
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)
+
+    def test_fused_pull_bit_identical_to_eager(self):
+        """The donated fused reducer must be BITWISE identical to the
+        pre-fusion pipeline (concat-psum + eager updater)."""
+        mesh = local_mesh()
+        W = mesh.devices.size
+        rng = np.random.default_rng(3)
+        keys = [f"k{i}" for i in range(12)]
+        vals = [rng.normal(size=(3 + i % 4,)).astype(np.float32)
+                for i in range(len(keys))]
+        # mesh dist_sync contract: grads carry a leading worker dim
+        grads1 = [rng.normal(size=(W, *v.shape)).astype(np.float32)
+                  for v in vals]
+        grads2 = [rng.normal(size=(W, *v.shape)).astype(np.float32)
+                  for v in vals]
+
+        fused = KVStore("dist_sync", learning_rate=0.25, mesh=mesh)
+        fused.init(keys, [v.copy() for v in vals])
+        eager = KVStore("dist_sync", learning_rate=0.25, mesh=mesh)
+        eager.init(keys, [v.copy() for v in vals])
+        eager.set_updater(lambda k, g, v: v - 0.25 * g)  # forces old path
+
+        for kv in (fused, eager):
+            kv.push(keys, grads1)
+            # half the keys accumulate a second push (owned buffers)
+            kv.push(keys[:6], grads2[:6])
+        out_f = fused.pull(keys)
+        out_e = eager.pull(keys)
+        for f, e in zip(out_f, out_e):
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(e))
+        assert fused.stats["sync_calls"] == 1
+
+    def test_fused_pull_does_not_donate_caller_arrays(self):
+        """First-push arrays are caller-owned: they must stay readable
+        (and reusable) after the fused pull donates its own buffers."""
+        mesh = local_mesh()
+        W = mesh.devices.size
+        lr = 1.0 / (2 * W)          # worker-dim sum of ones → step 0.5
+        kv = KVStore("dist_sync", learning_rate=lr, mesh=mesh)
+        kv.init("w", np.zeros(16, np.float32))
+        g = np.ones((W, 16), np.float32)
+        kv.push("w", g)
+        kv.pull("w")
+        np.testing.assert_array_equal(g, 1.0)        # still intact
+        kv.push("w", g)                              # and reusable
+        out = np.asarray(kv.pull("w"))
+        np.testing.assert_allclose(out, -1.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# scheduler + servers + client (in-process triad)
+# ---------------------------------------------------------------------------
+
+class _Fleet:
+    """In-process PS fleet for tests: scheduler + N server threads."""
+
+    def __init__(self, nworker=1, nserver=2, snapshot_dir=""):
+        self.sched = PSScheduler("127.0.0.1", nworker=nworker,
+                                 nserver=nserver)
+        self.sched.start()
+        self.servers = [
+            PSServer("127.0.0.1", self.sched.port, server_id=i,
+                     snapshot_dir=snapshot_dir,
+                     snapshot_stride=1 if snapshot_dir else 0)
+            for i in range(nserver)]
+        for s in self.servers:
+            s.start()
+        self.threads = [threading.Thread(target=s.serve_forever,
+                                         daemon=True)
+                        for s in self.servers]
+        for t in self.threads:
+            t.start()
+
+    def client(self, rank=0, **kw):
+        return PSClient(root_uri="127.0.0.1", root_port=self.sched.port,
+                        rank=rank, **kw)
+
+    def join(self):
+        for t in self.threads:
+            t.join(timeout=30)
+        self.sched.join(timeout=30)
+
+
+class TestPSTriad:
+    def test_push_pull_across_shards(self):
+        fleet = _Fleet(nworker=1, nserver=3)
+        c = fleet.client(staleness=4)
+        c.init("w", n_keys=100, lr=1.0)
+        # duplicate ids in one batch must accumulate exactly
+        ids = np.array([0, 50, 99, 50, 7], np.int64)
+        c.push("w", ids, np.ones(5, np.float32), wait=True)
+        got = c.pull("w", np.arange(100, dtype=np.int64))
+        want = np.zeros(100, np.float32)
+        np.add.at(want, ids, -1.0)                   # server: w -= lr*g
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(c.pull_dense("w"), want)
+        c.close()
+        fleet.join()
+
+    def test_init_value_and_width(self):
+        fleet = _Fleet(nworker=1, nserver=2)
+        c = fleet.client()
+        v = np.arange(20, dtype=np.float32).reshape(10, 2)
+        c.init("emb", n_keys=10, width=(2,), value=v)
+        got = c.pull("emb", np.arange(10, dtype=np.int64))
+        np.testing.assert_array_equal(got, v)
+        # idempotent: second init (another worker's) is a no-op
+        c.init("emb", n_keys=10, width=(2,), value=v * 7)
+        np.testing.assert_array_equal(
+            c.pull("emb", np.arange(10, dtype=np.int64)), v)
+        c.close()
+        fleet.join()
+
+    def test_server_side_normal_init_deterministic(self):
+        """init_scale draws are a pure function of (seed, range): two
+        independent fleets must hold identical factor matrices."""
+        dense = []
+        for _ in range(2):
+            fleet = _Fleet(nworker=1, nserver=3)
+            c = fleet.client()
+            c.init("v", n_keys=50, width=(4,), init_scale=0.01, seed=9)
+            dense.append(c.pull_dense("v"))
+            c.close()
+            fleet.join()
+        assert dense[0].std() > 0                    # actually random
+        np.testing.assert_array_equal(dense[0], dense[1])
+
+    def test_dist_async_kvstore_surface(self):
+        fleet = _Fleet(nworker=1, nserver=2)
+        kv = DistAsyncKVStore(fleet.client(), learning_rate=0.5)
+        kv.init("w", np.zeros(8, np.float32))
+        kv.push("w", np.ones(8, np.float32))
+        kv.flush()
+        out = np.asarray(kv.pull("w"))
+        np.testing.assert_allclose(out, -0.5, rtol=1e-6)
+        with pytest.raises(Error):
+            kv.set_updater(lambda k, g, v: v)
+        with pytest.raises(Error):
+            kv.pull("nope")
+        assert kv.num_workers == 1
+        kv.close()
+        fleet.join()
+
+    def test_fit_ps_learns(self):
+        """End-to-end sparse CTR: GBLinear.fit_ps over the triad must
+        beat chance comfortably on its own training shard."""
+        from dmlc_core_tpu.data.row_block import RowBlock
+        from dmlc_core_tpu.models.linear import GBLinear
+
+        rng = np.random.default_rng(1)
+        F, n, nnz = 5000, 2000, 8
+        hot = rng.choice(F, 32, replace=False)
+        w_true = rng.normal(size=32).astype(np.float32)
+        idx = rng.integers(0, F, size=(n, nnz)).astype(np.int64)
+        idx[:, :3] = hot[rng.integers(0, 32, size=(n, 3))]
+        vals = rng.normal(size=(n, nnz)).astype(np.float32)
+        order = np.argsort(hot)
+        pos = order[np.searchsorted(hot[order], idx[:, :3])]
+        y = ((vals[:, :3] * w_true[pos]).sum(1) > 0).astype(np.float32)
+        off = np.arange(0, n * nnz + 1, nnz, dtype=np.int64)
+        blocks = [RowBlock(offset=off, label=y, index=idx.ravel(),
+                           value=vals.ravel())]
+
+        fleet = _Fleet(nworker=1, nserver=2)
+        kv = DistAsyncKVStore(fleet.client(staleness=4),
+                              learning_rate=0.5)
+        model = GBLinear(learning_rate=0.5, reg_lambda=0.0)
+        model.fit_ps(blocks, kv, num_col=F, batch_rows=256, n_epochs=8)
+        assert model.weights is not None and len(model.weights) == F
+        rows = np.repeat(np.arange(n), nnz)
+        m = np.zeros(n, np.float32)
+        np.add.at(m, rows, model.weights[idx.ravel()] * vals.ravel())
+        m += model.bias
+        acc = ((m > 0) == (y > 0.5)).mean()
+        assert acc > 0.8, acc
+        assert max(kv.staleness_samples) <= 4
+        kv.close()
+        fleet.join()
+
+
+class TestCsrMinibatches:
+    def test_splits_and_passes_through(self):
+        from dmlc_core_tpu.data.iter import iter_csr_minibatches
+        from dmlc_core_tpu.data.row_block import RowBlock
+
+        def block(n, nnz_per_row):
+            off = np.arange(0, n * nnz_per_row + 1, nnz_per_row,
+                            dtype=np.int64)
+            return RowBlock(offset=off, label=np.zeros(n, np.float32),
+                            index=np.arange(n * nnz_per_row,
+                                            dtype=np.int64),
+                            value=None)
+
+        out = list(iter_csr_minibatches([block(10, 2), block(3, 1)], 4))
+        assert [b.size for b in out] == [4, 4, 2, 3]
+        # row contents preserved across the split
+        all_idx = np.concatenate([b.index for b in out[:3]])
+        np.testing.assert_array_equal(all_idx, np.arange(20))
