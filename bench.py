@@ -787,15 +787,18 @@ def _fleet_bench() -> None:
     consistent-hash router, with a staged v1->v2 rollout mid-run.
 
     Trains two GBT versions, checkpoints both, then stands up the full
-    fleet topology — FleetTracker + ``FLEET_REPLICAS`` subprocess
-    replicas + in-process FleetRouter — and drives it with the
+    fleet topology — FleetTracker + ``FLEET_REPLICAS`` replicas spawned
+    through the launch subsystem (a :class:`LauncherScaler`-backed
+    JobSet) + in-process FleetRouter — and drives it with the
     multi-process closed-loop load generator (heavy-tail request sizes,
     diurnal QPS ramp).  One third into the run a staged rollout
     (wave size 1) hot-swaps the fleet to v2 under load.  Every response
     is verified bit-exactly against the version it claims, so the final
     line's ``dropped``/``wrong`` counters ARE the zero-drop hot-swap
     acceptance evidence; per-replica balance comes from the router's
-    ``fleet_routed_total`` series."""
+    ``fleet_routed_total`` series, and the supervisor's view lands in
+    the final line's ``launch`` block (backend, respawns,
+    spawn_ms_p95)."""
     t0 = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
     n_replicas = int(os.environ.get("FLEET_REPLICAS", 3))
@@ -823,8 +826,8 @@ def _fleet_bench() -> None:
     from dmlc_core_tpu.models import HistGBT
     from dmlc_core_tpu.serve import checkpoint_model
     from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
-                                           HttpFleetAdmin, Rollout,
-                                           run_loadgen, spawn_replica)
+                                           HttpFleetAdmin, LauncherScaler,
+                                           Rollout, run_loadgen)
 
     rng = np.random.default_rng(11)
     Xt = rng.normal(size=(train_rows, feats)).astype(np.float32)
@@ -848,9 +851,8 @@ def _fleet_bench() -> None:
         "BENCH_FORCE_CPU") else None
     tracker = FleetTracker(nworker=max(8, n_replicas + 2))
     tracker.start()
-    replicas = [spawn_replica("127.0.0.1", tracker.port, model_uri=v1_uri,
-                              max_batch=64, extra_env=child_env)
-                for _ in range(n_replicas)]
+    scaler = LauncherScaler(tracker, v1_uri, initial=n_replicas,
+                            spawn_env=child_env)
     router = None
     rollout_report = {}
     try:
@@ -903,18 +905,14 @@ def _fleet_bench() -> None:
             "per_replica_routed": balance,
             "rollout": {k: rollout_report.get(k) for k in
                         ("version", "outcome", "waves")},
+            "launch": {k: scaler.jobset.stats()[k] for k in
+                       ("backend", "respawns", "spawn_ms_p95")},
             **cfg,
         }, final=True)
     finally:
         if router is not None:
             router.close()
-        for p in replicas:
-            if p.poll() is None:
-                p.terminate()
-                try:
-                    p.wait(timeout=15)
-                except Exception:  # noqa: BLE001
-                    p.kill()
+        scaler.reap(timeout=15)
         tracker.stop()
 
 

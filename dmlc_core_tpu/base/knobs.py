@@ -342,6 +342,25 @@ declare("DMLC_KVSTORE_CHECK", 0,
         "1 enables out-of-mesh KVStore consistency checks (debug).",
         "distributed")
 
+# -- multi-host launch ------------------------------------------------------
+declare("DMLC_LAUNCH_RESTART_LIMIT", 2,
+        "Per-rank respawn budget for a supervised JobSet (spawn "
+        "failures and unexpected exits both consume it; 0 disables "
+        "restarts).", "launch")
+declare("DMLC_LAUNCH_MONITOR_S", 0.2,
+        "JobSet supervisor poll interval in seconds (liveness poll, "
+        "respawn scheduling, tracker cross-check).", "launch")
+declare("DMLC_LAUNCH_GRACEFUL_S", 5.0,
+        "Teardown grace in seconds between SIGTERM and SIGKILL when a "
+        "JobSet shuts its workers down.", "launch")
+declare("DMLC_LAUNCH_LOG_DIR", "",
+        "Directory for per-worker launch log files; empty uses a fresh "
+        "temp dir per transport.", "launch")
+declare("DMLC_LAUNCH_WEDGE_CYCLES", 25,
+        "Consecutive monitor cycles a rank may stay process-alive but "
+        "tracker-lost before the JobSet declares it wedged and kills "
+        "it for respawn.", "launch")
+
 # -- parameter server -------------------------------------------------------
 declare("DMLC_PS_STALENESS", 4,
         "Bounded-staleness window tau for dist_async pulls: a pull at "

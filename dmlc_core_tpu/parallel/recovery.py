@@ -68,7 +68,7 @@ from dmlc_core_tpu.tracker.tracker import RabitTracker
 __all__ = [
     "CollectiveAborted", "WorkerAborted", "EvictedError",
     "RecoveryConfig", "RoundCheckpointer", "ElasticTracker",
-    "ElasticSession", "ElasticTrainer", "fold_parts",
+    "ElasticSession", "ElasticTrainer", "ElasticLauncher", "fold_parts",
     "truncate_to_round",
 ]
 
@@ -524,6 +524,86 @@ class ElasticTracker(RabitTracker):
             return np.bitwise_or.reduce([np.asarray(p) for p in order])
         self._break_epoch_locked(f"unknown collective op {op!r}")
         return None
+
+
+# ---------------------------------------------------------------------------
+# multi-host elastic launch: tracker + supervised JobSet
+# ---------------------------------------------------------------------------
+
+class ElasticLauncher:
+    """An :class:`ElasticTracker` plus the supervised
+    :class:`~dmlc_core_tpu.launch.JobSet` that keeps its worker set full.
+
+    Before the launch subsystem, elastic recovery could only *tolerate*
+    a dead rank (grace-window rejoin, or elastic shrink once grace
+    lapsed) — nothing relaunched it.  This closes the loop: the JobSet
+    respawns a dead rank (with backoff, under the restart budget) on a
+    surviving host, the replacement reclaims its rank via the tracker's
+    ``recover`` path inside the grace window, rolls to the recovery
+    floor and replays — so a host failure costs replayed rounds, not a
+    shrunken world.  The JobSet's ``tracker=`` cross-check also reaps
+    wedged workers (process alive, heartbeat lost).
+
+    Workers must pin their tracker rank to ``DMLC_TASK_ID`` (i.e.
+    ``ElasticSession(uri, port, rank=int(env["DMLC_TASK_ID"]))``) so a
+    respawned attempt reclaims the rank it replaces.
+    """
+
+    def __init__(self, command: List[str], nworker: int,
+                 transport: Any = None, host_ip: str = "127.0.0.1",
+                 grace_s: Optional[float] = None,
+                 elastic: Optional[bool] = None,
+                 envs: Optional[Dict[str, str]] = None,
+                 restart_limit: Optional[int] = None,
+                 monitor_s: Optional[float] = None,
+                 name: str = "elastic",
+                 env_for: Optional[Callable[[int, int],
+                                            Dict[str, str]]] = None):
+        self.tracker = ElasticTracker(host_ip=host_ip, nworker=nworker,
+                                      grace_s=grace_s, elastic=elastic)
+        self._command = list(command)
+        self._nworker = nworker
+        self._transport = transport
+        self._envs = dict(envs or {})
+        self._restart_limit = restart_limit
+        self._monitor_s = monitor_s
+        self._name = name
+        self._env_for = env_for
+        self.jobset: Any = None
+
+    def launch(self) -> "ElasticLauncher":
+        """Start the tracker, then the supervised worker set wired to it
+        (env ABI = ``slave_envs()``, liveness cross-check = tracker)."""
+        from dmlc_core_tpu.launch import JobSet
+
+        self.tracker.start()
+        envs = dict(self.tracker.slave_envs())
+        envs.update(self._envs)
+        self.jobset = JobSet(
+            self._command, self._nworker, transport=self._transport,
+            envs=envs, name=self._name,
+            restart_limit=self._restart_limit, monitor_s=self._monitor_s,
+            tracker=self.tracker, env_for=self._env_for)
+        self.jobset.launch()
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[int, int]:
+        CHECK(self.jobset is not None, "ElasticLauncher: launch() first")
+        return self.jobset.wait(timeout=timeout)
+
+    def run(self, timeout: Optional[float] = None) -> List[int]:
+        """launch + wait + teardown; exit codes in rank order."""
+        self.launch()
+        try:
+            codes = self.wait(timeout=timeout)
+        finally:
+            self.shutdown()
+        return [codes[r] for r in sorted(codes)]
+
+    def shutdown(self) -> None:
+        if self.jobset is not None:
+            self.jobset.shutdown()
+        self.tracker.stop()
 
 
 # ---------------------------------------------------------------------------

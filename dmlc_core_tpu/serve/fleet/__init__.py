@@ -28,6 +28,7 @@ Topology, failure model and knobs: ``doc/serving.md`` (Fleet section).
 
 from dmlc_core_tpu.serve.fleet.autoscale import (AutoscaleLoop,  # noqa: F401
                                                  AutoscalePolicy,
+                                                 LauncherScaler,
                                                  LocalProcessScaler)
 from dmlc_core_tpu.serve.fleet.instruments import fleet_metrics  # noqa: F401
 from dmlc_core_tpu.serve.fleet.loadgen import (diurnal_qps,  # noqa: F401
@@ -46,5 +47,6 @@ __all__ = [
     "Rollout", "RolloutController", "FleetAdmin", "HttpFleetAdmin",
     "plan_waves",
     "AutoscalePolicy", "AutoscaleLoop", "LocalProcessScaler",
+    "LauncherScaler",
     "run_loadgen", "sample_size", "diurnal_qps", "fleet_metrics",
 ]

@@ -19,6 +19,10 @@ callback/backend hook for in-process action.
 ``spawn_replica``'s a new process on scale-out and drains + shuts down
 the youngest replica on scale-in — the local-multiprocess analogue of
 the paper's ``dmlc_tracker/local.py`` launcher, closed into a loop.
+:class:`LauncherScaler` is the same loop over the launch subsystem: the
+fleet is a supervised JobSet on any Transport (fake hosts in CI, SSH or
+k8s in production), so crashed replicas respawn and retired ones stay
+retired.
 """
 
 from __future__ import annotations
@@ -33,9 +37,11 @@ from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.resilience import RetryPolicy
 from dmlc_core_tpu.io.http_util import http_request
 from dmlc_core_tpu.serve.fleet.instruments import fleet_metrics
-from dmlc_core_tpu.serve.fleet.replica import FleetTracker, spawn_replica
+from dmlc_core_tpu.serve.fleet.replica import (REPLICA_COMMAND, FleetTracker,
+                                               replica_env, spawn_replica)
 
-__all__ = ["AutoscalePolicy", "LocalProcessScaler", "AutoscaleLoop"]
+__all__ = ["AutoscalePolicy", "LocalProcessScaler", "LauncherScaler",
+           "AutoscaleLoop"]
 
 _ONE_ATTEMPT = RetryPolicy(max_attempts=1)
 
@@ -167,6 +173,75 @@ class LocalProcessScaler:
                 proc.wait(timeout=5.0)
 
 
+class LauncherScaler:
+    """Launcher-backed autoscale backend: replicas are ranks of a
+    supervised :class:`~dmlc_core_tpu.launch.JobSet`.
+
+    Where :class:`LocalProcessScaler` forks bare local processes, this
+    backend scales over any launch Transport — FakeTransport hosts in
+    the CI drill, SSH slots or a k8s namespace in production — and gets
+    the JobSet's supervision for free: a replica that *crashes* is
+    respawned with backoff on a live host, while a replica retired by
+    scale-in exits cleanly (drain → ``/admin/shutdown`` → code 0) and
+    is NOT brought back.  Scale-out is :meth:`JobSet.add_rank`.
+    """
+
+    def __init__(self, tracker: FleetTracker, model_uri: Optional[str],
+                 name: str = "fleet", transport: Optional[Any] = None,
+                 initial: int = 0,
+                 spawn_env: Optional[Dict[str, str]] = None,
+                 restart_limit: Optional[int] = None):
+        from dmlc_core_tpu.launch import JobSet
+
+        self._tracker = tracker
+        self.jobset = JobSet(
+            REPLICA_COMMAND, initial, transport=transport,
+            envs=replica_env(tracker.host_ip, tracker.port,
+                             model_uri=model_uri, name=name,
+                             extra_env=spawn_env),
+            name=f"{name}-scaler", role="replica",
+            restart_limit=restart_limit)
+        self.jobset.launch()
+
+    def scale(self, direction: int) -> bool:
+        """Execute one recommendation; True when an action was taken."""
+        if direction > 0:
+            return self.scale_out()
+        if direction < 0:
+            return self.scale_in()
+        return False
+
+    def scale_out(self) -> bool:
+        rank = self.jobset.add_rank()
+        LOG("INFO", "fleet.autoscale: launched replica as jobset rank %d "
+            "on %s", rank, self.jobset.rank_host(rank))
+        if _metrics.enabled():
+            fleet_metrics()["autoscale_events"].inc(1, direction="out")
+        return True
+
+    def scale_in(self) -> bool:
+        endpoints = self._tracker.serve_endpoints()
+        if not endpoints:
+            return False
+        rank = max(endpoints)       # youngest rank retires first
+        try:
+            http_request("POST", endpoints[rank] + "/admin/shutdown",
+                         None, b"{}", ok=(200,), retry=_ONE_ATTEMPT,
+                         op="fleet_autoscale")
+        except Exception as e:  # noqa: BLE001 — already gone is fine
+            LOG("WARNING", "fleet.autoscale: retire of rank %d failed: "
+                "%s", rank, e)
+            return False
+        LOG("INFO", "fleet.autoscale: retired replica rank %d", rank)
+        if _metrics.enabled():
+            fleet_metrics()["autoscale_events"].inc(1, direction="in")
+        return True
+
+    def reap(self, timeout: float = 10.0) -> None:
+        """Graceful teardown of every launcher-owned replica."""
+        self.jobset.shutdown(graceful_s=timeout)
+
+
 def fleet_queue_wait_p99(tracker: FleetTracker) -> Optional[float]:
     """The policy's default signal: the WORST replica's heartbeat-borne
     queue-wait p99 (None while no replica has served traffic)."""
@@ -188,7 +263,7 @@ class AutoscaleLoop:
 
     def __init__(self, tracker: FleetTracker,
                  policy: Optional[AutoscalePolicy] = None,
-                 backend: Optional[LocalProcessScaler] = None,
+                 backend: Optional[Any] = None,
                  on_decision: Optional[
                      Callable[[int, Optional[float], int], None]] = None,
                  interval_s: float = 0.5):

@@ -160,6 +160,13 @@ def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
     eps = [endpoints] if isinstance(endpoints, str) else list(endpoints)
     workdir = workdir or tempfile.mkdtemp(prefix="fleet_loadgen_")
     child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # `-m dmlc_core_tpu...` resolves against the child's cwd — pin the
+    # package root so workers import regardless of the caller's cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    prior = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = \
+        (pkg_root + os.pathsep + prior) if prior else pkg_root
     child_env.update(env or {})
     children = []
     t0 = time.monotonic()

@@ -35,7 +35,6 @@ import json
 import os
 import signal
 import socket
-import subprocess
 import sys
 import threading
 from typing import Any, Dict, List, Optional, Tuple
@@ -48,8 +47,8 @@ from dmlc_core_tpu.serve.instruments import serve_metrics
 from dmlc_core_tpu.serve.registry import ModelRegistry
 from dmlc_core_tpu.tracker.tracker import RabitTracker, WorkerSession
 
-__all__ = ["FleetTracker", "ReplicaFrontend", "Replica", "spawn_replica",
-           "replica_main"]
+__all__ = ["FleetTracker", "ReplicaFrontend", "Replica", "REPLICA_COMMAND",
+           "replica_env", "spawn_replica", "replica_main"]
 
 
 def _heartbeat_s() -> float:
@@ -278,6 +277,39 @@ class Replica:
             self.session.close()
 
 
+def replica_env(tracker_uri: str, tracker_port: int,
+                model_uri: Optional[str] = None, name: str = "fleet",
+                max_batch: int = 64, max_queue: int = 256,
+                extra_env: Optional[Dict[str, str]] = None
+                ) -> Dict[str, str]:
+    """The ``FLEET_*`` env overlay a replica subprocess is spawned with
+    (pure — the golden env tests snapshot this)."""
+    env = {"FLEET_TRACKER_URI": tracker_uri,
+           "FLEET_TRACKER_PORT": str(tracker_port),
+           "FLEET_NAME": name,
+           "FLEET_MAX_BATCH": str(max_batch),
+           "FLEET_MAX_QUEUE": str(max_queue)}
+    if model_uri:
+        env["FLEET_MODEL_URI"] = model_uri
+    # `python -m dmlc_core_tpu...` resolves against the child's cwd,
+    # not the parent's sys.path — pin the package root so supervised
+    # replicas import regardless of where the caller was launched
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    prior = os.environ.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = (pkg_root + os.pathsep + prior) if prior \
+        else pkg_root
+    env.update(extra_env or {})
+    return env
+
+
+REPLICA_COMMAND = [sys.executable, "-m", "dmlc_core_tpu.serve.fleet.replica"]
+
+_spawn_lock = threading.Lock()
+_spawn_transport: Optional[Any] = None
+_spawn_seq = 0
+
+
 def spawn_replica(tracker_uri: str, tracker_port: int,
                   model_uri: Optional[str] = None, name: str = "fleet",
                   max_batch: int = 64, max_queue: int = 256,
@@ -287,19 +319,28 @@ def spawn_replica(tracker_uri: str, tracker_port: int,
     dmlc_core_tpu.serve.fleet.replica``) wired to the tracker via the
     ``FLEET_*`` env ABI.  Used by the local autoscale backend, the
     fleet drill, and ``bench.py --fleet``.  The spawned replica is
-    *ready* once its rank appears in ``tracker.serve_endpoints()``."""
-    env = dict(os.environ,
-               FLEET_TRACKER_URI=tracker_uri,
-               FLEET_TRACKER_PORT=str(tracker_port),
-               FLEET_NAME=name,
-               FLEET_MAX_BATCH=str(max_batch),
-               FLEET_MAX_QUEUE=str(max_queue))
-    if model_uri:
-        env["FLEET_MODEL_URI"] = model_uri
-    env.update(extra_env or {})
-    return subprocess.Popen(
-        [sys.executable, "-m", "dmlc_core_tpu.serve.fleet.replica"],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    *ready* once its rank appears in ``tracker.serve_endpoints()``.
+
+    Spawns through :class:`~dmlc_core_tpu.launch.LocalTransport` (child
+    carries ``PR_SET_PDEATHSIG``, output captured to a per-replica log
+    file) but still returns the raw ``Popen`` for callers that wait/kill
+    directly; supervised fleets use :class:`LauncherScaler` instead.
+    """
+    global _spawn_transport, _spawn_seq
+    from dmlc_core_tpu.launch import LocalTransport
+
+    with _spawn_lock:
+        if _spawn_transport is None:
+            _spawn_transport = LocalTransport()
+        _spawn_seq += 1
+        seq = _spawn_seq
+    handle = _spawn_transport.spawn(
+        REPLICA_COMMAND,
+        replica_env(tracker_uri, tracker_port, model_uri=model_uri,
+                    name=name, max_batch=max_batch, max_queue=max_queue,
+                    extra_env=extra_env),
+        _spawn_transport.hosts()[0], label=f"{name}-replica-{seq}")
+    return handle.proc
 
 
 def replica_main(argv: Optional[List[str]] = None) -> int:

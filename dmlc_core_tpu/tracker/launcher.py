@@ -29,13 +29,20 @@ _RANK_VARS = [
 ]
 
 
-def task_id_from_env(env: Optional[Dict[str, str]] = None) -> int:
+def task_id_from_env(env: Optional[Dict[str, str]] = None,
+                     required: bool = False) -> int:
     """Worker index assigned by the cluster manager, read from the DMLC
-    launcher env (``DMLC_TASK_ID``)."""
+    launcher env (``DMLC_TASK_ID``) or a cluster-manager rank variable
+    in ``_RANK_VARS`` precedence order.  Defaults to 0 when nothing is
+    set (single-process convenience); pass ``required=True`` to CHECK
+    instead — the multi-host path, where a silent rank-0 default would
+    collide every worker onto the same rank."""
     env = os.environ if env is None else env
     for var in _RANK_VARS:
         if var in env and str(env[var]).strip() != "":
             return int(env[var])
+    CHECK(not required,
+          f"no rank variable set (looked for {', '.join(_RANK_VARS)})")
     return 0
 
 

@@ -4,11 +4,17 @@ Reference parity: ``tracker/dmlc_tracker/local.py`` — fork N worker
 subprocesses on one machine with the env ABI injected.  This is how the
 reference "tests multi-node without a cluster" (SURVEY.md §4), and how we
 exercise ``jax.distributed`` + cross-process collectives on CPU.
+
+Since the launch subsystem landed this is a thin shim over a supervised
+:class:`~dmlc_core_tpu.launch.JobSet` on a
+:class:`~dmlc_core_tpu.launch.LocalTransport` — same signature and
+return value, but children carry ``PR_SET_PDEATHSIG`` (no orphan leak on
+parent death) and every handle is owned until teardown instead of
+fire-and-forget.
 """
 
 from __future__ import annotations
 
-import os
 import subprocess
 from typing import Dict, List, Optional
 
@@ -31,24 +37,19 @@ def launch(
     a jax.distributed cluster with process 0 hosting the coordinator at
     ``DMLC_TRACKER_URI:DMLC_TRACKER_PORT``.
     """
+    from dmlc_core_tpu.launch import JobSet, LaunchTimeout, LocalTransport
+
     CHECK(len(command) > 0, "local.launch: empty worker command")
-    procs = []
-    for task_id in range(nworker):
-        env = dict(os.environ)
-        env.update(envs)
-        if extra_env:
-            env.update(extra_env)
-        env["DMLC_TASK_ID"] = str(task_id)
-        env["DMLC_ROLE"] = "worker"
-        procs.append(subprocess.Popen(command, env=env))
-    codes = []
+    merged = dict(envs)
+    if extra_env:
+        merged.update(extra_env)
+    js = JobSet(command, nworker, transport=LocalTransport(),
+                envs=merged, name="local", restart_limit=0)
     try:
-        for p in procs:
-            codes.append(p.wait(timeout=timeout))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        raise
+        codes = js.run(timeout=timeout)
+    except LaunchTimeout:
+        # historical contract: callers catch subprocess.TimeoutExpired
+        raise subprocess.TimeoutExpired(command, timeout)  # noqa: B904
     failed = [i for i, c in enumerate(codes) if c != 0]
     if failed:
         LOG("ERROR", "local launch: workers %s exited nonzero (%s)", failed,

@@ -49,7 +49,11 @@ def get_opts(args: Optional[List[str]] = None) -> Tuple[argparse.Namespace, List
     parser.add_argument("--mesos-master", type=str, default=None,
                         help="mesos master host:port")
     parser.add_argument("--max-attempts", type=int, default=3,
-                        help="max restart attempts per worker (kubernetes)")
+                        help="max launch attempts per worker (JobSet restart "
+                             "budget is max-attempts - 1)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="kubernetes: render Job manifests without "
+                             "invoking kubectl")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VALUE env for workers (repeatable)")
     parser.add_argument("--log-level", choices=["DEBUG", "INFO", "WARNING", "ERROR"],

@@ -2,37 +2,44 @@
 
 Reference parity: ``tracker/dmlc_tracker/ssh.py`` — read a host file, start
 one worker per slot via ``ssh host 'env ... cmd'`` (SURVEY.md §2c).
+
+Since the launch subsystem landed this is a thin shim over a supervised
+:class:`~dmlc_core_tpu.launch.JobSet` on an
+:class:`~dmlc_core_tpu.launch.SSHTransport` — same signature and return
+value, but the ssh client processes are owned handles (polled, signalled
+and reaped at teardown) instead of fire-and-forget Popens.
 """
 
 from __future__ import annotations
 
 import os
-import shlex
-import subprocess
 from typing import Dict, List, Optional
 
-from dmlc_core_tpu.base.logging import CHECK, LOG
+from dmlc_core_tpu.base.logging import CHECK
 
 __all__ = ["launch", "read_host_file"]
 
 
 def read_host_file(path: str) -> List[str]:
     """Read an MPI-style host file (one ``host[:slots]`` per line, ``#``
-    comments) into a host list."""
-    hosts = []
+    comments) into a host slot list: a host with ``:slots`` appears that
+    many times, so round-robin placement fills its slots."""
+    hosts: List[str] = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line and not line.startswith("#"):
-                hosts.append(line.split()[0])
+            if not line or line.startswith("#"):
+                continue
+            token = line.split()[0]
+            host, sep, slots = token.rpartition(":")
+            if sep and slots.isdigit():
+                CHECK(int(slots) > 0,
+                      f"host file {path!r}: bad slot count in {token!r}")
+                hosts.extend([host] * int(slots))
+            else:
+                hosts.append(token)
     CHECK(len(hosts) > 0, f"host file {path!r} has no hosts")
     return hosts
-
-
-def _remote_command(command: List[str], env: Dict[str, str], cwd: str) -> str:
-    env_part = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-    cmd_part = " ".join(shlex.quote(c) for c in command)
-    return f"cd {shlex.quote(cwd)} && env {env_part} {cmd_part}"
 
 
 def launch(
@@ -44,17 +51,11 @@ def launch(
     ssh_binary: str = "ssh",
 ) -> List[int]:
     """Start workers round-robin over ``hosts``; wait for completion."""
+    from dmlc_core_tpu.launch import JobSet, SSHTransport
+
     CHECK(len(command) > 0, "ssh.launch: empty worker command")
-    cwd = cwd or os.getcwd()
-    procs = []
-    for task_id in range(nworker):
-        host = hosts[task_id % len(hosts)]
-        env = dict(envs)
-        env["DMLC_TASK_ID"] = str(task_id)
-        env["DMLC_ROLE"] = "worker"
-        remote = _remote_command(command, env, cwd)
-        LOG("INFO", "ssh worker %d → %s", task_id, host)
-        procs.append(
-            subprocess.Popen([ssh_binary, "-o", "StrictHostKeyChecking=no", host, remote])
-        )
-    return [p.wait() for p in procs]
+    transport = SSHTransport(hosts, cwd=cwd or os.getcwd(),
+                             ssh_binary=ssh_binary)
+    js = JobSet(command, nworker, transport=transport, envs=envs,
+                name="ssh", restart_limit=0)
+    return js.run()

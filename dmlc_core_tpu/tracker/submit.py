@@ -16,6 +16,7 @@ import sys
 from typing import List, Optional
 
 from dmlc_core_tpu.base.logging import CHECK, set_log_level
+from dmlc_core_tpu.launch.config import SUPERVISED_CLUSTERS, jobset_from_opts
 from dmlc_core_tpu.tracker.opts import get_opts
 from dmlc_core_tpu.tracker.tracker import submit as tracker_submit
 
@@ -35,14 +36,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     def fun_submit(n_total: int, envs) -> None:
         envs = {**envs, **extra_env}
         nw = opts.num_workers
-        if opts.cluster == "local":
-            from dmlc_core_tpu.tracker import local as be
-            exit_codes.extend(be.launch(nw, command, envs))
-        elif opts.cluster == "ssh":
-            from dmlc_core_tpu.tracker import ssh as be
-            CHECK(opts.host_file is not None, "--cluster ssh needs --host-file")
-            hosts = be.read_host_file(opts.host_file)
-            exit_codes.extend(be.launch(nw, command, envs, hosts))
+        if opts.cluster in SUPERVISED_CLUSTERS:
+            # local/ssh/kubernetes are configurations of the same
+            # supervised JobSet — only the transport differs
+            exit_codes.extend(jobset_from_opts(opts, command, envs).run())
         elif opts.cluster == "mpi":
             from dmlc_core_tpu.tracker import mpi as be
             exit_codes.extend(be.launch(nw, command, envs, host_file=opts.host_file))
@@ -68,13 +65,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                 nw, command, envs, master=opts.mesos_master, jobname=opts.jobname,
                 worker_cores=opts.worker_cores or 1,
                 worker_memory_mb=opts.worker_memory or 1024))
-        elif opts.cluster == "kubernetes":
-            from dmlc_core_tpu.tracker import kubernetes as be
-            CHECK(opts.image is not None, "--cluster kubernetes needs --image")
-            exit_codes.extend(be.launch(
-                nw, command, envs, image=opts.image, jobname=opts.jobname,
-                worker_cores=opts.worker_cores, worker_memory_mb=opts.worker_memory,
-                max_attempts=opts.max_attempts))
 
     tracker = tracker_submit(
         opts.num_workers,

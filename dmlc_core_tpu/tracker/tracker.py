@@ -26,7 +26,6 @@ from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
 from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.timer import get_time
-from dmlc_core_tpu.parallel.collectives import get_link_map
 from dmlc_core_tpu.utils.profiler import global_tracer, tracing_enabled
 
 __all__ = ["RabitTracker", "WorkerSession", "PSTracker", "submit"]
@@ -95,6 +94,11 @@ class RabitTracker:
         self._sock.listen(max(16, nworker))
         self.host_ip = host_ip
         self.port = self._sock.getsockname()[1]
+        # deferred import: parallel/__init__ pulls in recovery, which
+        # subclasses RabitTracker — a module-level import here made
+        # ``import dmlc_core_tpu.tracker`` order-dependent (circular)
+        from dmlc_core_tpu.parallel.collectives import get_link_map
+
         self._links = get_link_map(nworker)
         self._next_rank = 0
         self._host_rank: Dict[str, int] = {}  # host-aware rank reuse

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Multi-host launch drill for CI: supervised respawn on a fake cluster.
+
+Proves the launch subsystem end to end WITHOUT any real SSH/k8s — the
+"cluster" is a :class:`~dmlc_core_tpu.launch.FakeTransport` of 3 virtual
+hosts whose failures are scripted through the ``base/faultinject``
+grammar:
+
+1. **Elastic fit under host death** — an
+   :class:`~dmlc_core_tpu.parallel.recovery.ElasticLauncher` (tracker +
+   supervised JobSet) runs a 4-rank data-parallel fit over the 3 fake
+   hosts.  Mid-round, ``launch_host:kill=h1`` downs host ``h1``:
+   SIGKILLs its worker and refuses further spawns there.  The JobSet
+   must respawn the lost rank on a SURVIVING host; the replacement
+   reclaims its tracker rank inside the grace window, rolls back to the
+   recovery floor and replays — and every finished ensemble must be
+   byte-identical to an uninterrupted baseline run.
+2. **Fleet scale-out over fake hosts** — a
+   :class:`~dmlc_core_tpu.serve.fleet.LauncherScaler` (JobSet-backed
+   autoscale backend) grows a serving fleet from 2 to 4 replicas placed
+   across the fake hosts while a closed-loop verified load generator
+   runs through the transition: zero dropped, zero wrong.
+
+The whole drill runs under ``DMLC_LOCKCHECK=1`` + ``DMLC_RACECHECK=1``
+with zero findings required; the racecheck report is archived to
+``LAUNCH_RACECHECK_OUT`` (default ``/tmp/launch_racecheck.json``).
+Exit 0 = drill green.  Usage:
+    python scripts/check_launch.py            # run the drill
+    python scripts/check_launch.py --worker   # (internal worker entry)
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_WORKERS = 4
+TOTAL_ROUNDS = 10
+STRIDE = 2
+N_ROWS, N_FEAT = 1500, 8
+HOSTS = ["h0", "h1", "h2"]
+LOAD_S = 6.0
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(N_ROWS, N_FEAT)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] - 0.5 * X[:, 3] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# worker entry (subprocess, spawned by the JobSet)
+# ---------------------------------------------------------------------------
+
+def worker_main() -> None:
+    from dmlc_core_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.data.iter import ArrayRowIter
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.parallel.recovery import (ElasticSession,
+                                                 ElasticTrainer)
+
+    # the launch ABI is the whole bootstrap: tracker address from
+    # slave_envs(), rank pinned to DMLC_TASK_ID so a respawned attempt
+    # reclaims the rank it replaces
+    port = int(os.environ["DMLC_TRACKER_PORT"])
+    rank = int(os.environ["DMLC_TASK_ID"])
+    out_dir = os.environ["LAUNCH_OUT"]
+    X, y = _dataset()
+
+    sess = ElasticSession(os.environ["DMLC_TRACKER_URI"], port, rank=rank)
+    model = HistGBT(n_trees=TOTAL_ROUNDS, max_depth=3, n_bins=16,
+                    learning_rate=0.3)
+    trainer = ElasticTrainer(model, TOTAL_ROUNDS)  # stride/dir via knobs
+    trainer.run(sess,
+                lambda lo, hi: ArrayRowIter(X[lo:hi], y[lo:hi]),
+                N_ROWS, join_timeout_s=300)
+    model.save_model(os.path.join(out_dir, f"model-rank{sess.grank}.gbt"))
+    sess.shutdown()
+    lockcheck.check()   # zero lock-order cycles, or die loudly
+
+
+# ---------------------------------------------------------------------------
+# parent: drive the fake cluster
+# ---------------------------------------------------------------------------
+
+def _check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def _read_models(out_dir):
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.startswith("model-rank") and name.endswith(".gbt"):
+            with open(os.path.join(out_dir, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def _metric_total(counter, **labels):
+    return sum(s["value"] for s in counter._snap()
+               if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
+def _elastic_fit(tmp, tag, fault=""):
+    """One supervised 4-rank fit over the fake cluster; returns
+    (model bytes by rank file, launcher) after asserting clean exits."""
+    from dmlc_core_tpu.base import faultinject
+    from dmlc_core_tpu.launch import FakeTransport
+    from dmlc_core_tpu.parallel.recovery import ElasticLauncher
+
+    out_dir = os.path.join(tmp, f"out-{tag}")
+    rec_dir = os.path.join(tmp, f"rec-{tag}")
+    os.makedirs(out_dir)
+    transport = FakeTransport(hosts=list(HOSTS),
+                              log_dir=os.path.join(tmp, f"logs-{tag}"))
+    launcher = ElasticLauncher(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        N_WORKERS, transport=transport, grace_s=120.0,
+        envs={"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
+              "DMLC_LOCKCHECK": "1", "DMLC_RACECHECK": "1",
+              "DMLC_RECOVERY_DIR": rec_dir,
+              "DMLC_RECOVERY_STRIDE": str(STRIDE),
+              "DMLC_FAULT_INJECT": "",      # children never inherit ours
+              "LAUNCH_OUT": out_dir},
+        restart_limit=2, monitor_s=0.05, name=f"elastic-{tag}")
+    with faultinject.inject(fault):
+        codes = launcher.run(timeout=900)
+    _check(codes == [0] * N_WORKERS,
+           f"{tag}: all {N_WORKERS} ranks finished clean ({codes})")
+    models = _read_models(out_dir)
+    _check(len(models) == N_WORKERS, f"{tag}: {N_WORKERS} ensembles saved")
+    blobs = list(models.values())
+    _check(all(b == blobs[0] for b in blobs),
+           f"{tag}: ensembles byte-identical across ranks")
+    return blobs[0], launcher, transport
+
+
+def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        worker_main()
+        return
+
+    os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    os.environ.setdefault("DMLC_RACECHECK", "1")
+    from dmlc_core_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    import numpy as np
+
+    from dmlc_core_tpu.base import lockcheck, racecheck
+    from dmlc_core_tpu.launch import launch_metrics
+
+    tmp = tempfile.mkdtemp(prefix="dmlc_launch")
+
+    # -- stage 1a: uninterrupted baseline on the fake cluster -----------
+    baseline, launcher, _ = _elastic_fit(tmp, "baseline")
+    _check(launcher.jobset.respawns() == 0, "baseline: zero respawns")
+    st = launcher.jobset.stats()
+    _check(st["backend"] == "fake" and st["spawns"] == N_WORKERS,
+           f"baseline: {N_WORKERS} spawns over the fake transport")
+
+    # -- stage 1b: host h1 dies mid-round; JobSet respawns the rank -----
+    blob, launcher, transport = _elastic_fit(
+        tmp, "chaos", fault="launch_host:kill=h1:after=60:n=1")
+    _check(transport.down_hosts() == ["h1"],
+           "chaos: fake host h1 was downed by the injected fault")
+    _check(launcher.jobset.respawns() >= 1,
+           f"chaos: JobSet respawned the lost rank "
+           f"({launcher.jobset.respawns()} respawns)")
+    ranks = launcher.jobset.stats()["ranks"]
+    _check(ranks[1]["host"] in ("h0", "h2"),
+           f"chaos: rank 1 relanded on a surviving host "
+           f"({ranks[1]['host']})")
+    kinds = [e["event"] for e in launcher.jobset.events()]
+    _check("respawn" in kinds and "exit" in kinds,
+           "chaos: lifecycle events recorded (exit → respawn)")
+    _check(_metric_total(launch_metrics()["respawns"]) >= 1,
+           "chaos: dmlc_launch_respawns_total counted")
+    _check(blob == baseline,
+           "chaos: recovered ensembles byte-identical to the "
+           "uninterrupted baseline")
+
+    # -- stage 2: fleet 2 -> 4 replicas across fake hosts under load ----
+    from dmlc_core_tpu.launch import FakeTransport
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.serve import checkpoint_model
+    from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
+                                           LauncherScaler, run_loadgen)
+
+    X, y = _dataset()
+    m1 = HistGBT(n_trees=4, max_depth=3, n_bins=16).fit(X, y)
+    v1_uri = f"file://{tmp}/v1.ckpt"
+    checkpoint_model(v1_uri, m1, version=1)
+    expected_npz = os.path.join(tmp, "expected.npz")
+    np.savez(expected_npz, X=X, v1=m1.predict(X))
+
+    child_env = {"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
+                 "DMLC_LOCKCHECK": "1", "DMLC_RACECHECK": "1",
+                 "DMLC_FAULT_INJECT": ""}
+    tracker = FleetTracker(nworker=8)
+    tracker.start()
+    fleet_tr = FakeTransport(hosts=["f0", "f1"],
+                             log_dir=os.path.join(tmp, "logs-fleet"))
+    scaler = LauncherScaler(tracker, v1_uri, transport=fleet_tr,
+                            initial=2, spawn_env=child_env)
+    router = None
+
+    def _wait(pred, timeout_s, label):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if pred():
+                return
+            time.sleep(0.1)
+        _check(False, f"timed out waiting for {label}")
+
+    try:
+        _wait(lambda: len(tracker.serve_endpoints()) == 2,
+              180, "initial replica registration")
+        _check(True, "fleet: 2 launcher-backed replicas registered")
+        router = FleetRouter(tracker, probe_s=0.2).start()
+
+        load = {}
+
+        def _loadgen_bg():
+            load.update(run_loadgen(
+                router.url, expected_npz, duration_s=LOAD_S, procs=2,
+                threads=3, base_qps=60.0, timeout_ms=10_000,
+                workdir=tmp, env=child_env))
+
+        t = threading.Thread(target=_loadgen_bg)
+        t.start()
+        time.sleep(LOAD_S / 4.0)
+        scaler.scale(1)
+        scaler.scale(1)
+        _wait(lambda: len(tracker.serve_endpoints()) == 4,
+              180, "scaled-out replica registration")
+        _check(True, "fleet: scaled 2 -> 4 replicas through the JobSet")
+        t.join(timeout=LOAD_S + 180)
+        _check(not t.is_alive(), "fleet: load generator finished")
+        _check(load.get("dropped") == 0 and load.get("wrong") == 0,
+               f"fleet: zero dropped / zero wrong through the scale-out "
+               f"({load.get('ok')} ok of {load.get('count')})")
+        st = scaler.jobset.stats()
+        hosts_used = sorted({r["host"] for r in st["ranks"].values()
+                             if r["host"]})
+        _check(hosts_used == ["f0", "f1"],
+               f"fleet: replicas placed across fake hosts {hosts_used}")
+        _check(st["spawn_ms_p95"] > 0,
+               f"fleet: spawn latency recorded "
+               f"(p95 {st['spawn_ms_p95']:.1f} ms)")
+    finally:
+        if router is not None:
+            router.close()
+        scaler.reap(timeout=15)
+        tracker.stop()
+
+    lockcheck.check()
+    print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    rc_out = os.environ.get("LAUNCH_RACECHECK_OUT",
+                            "/tmp/launch_racecheck.json")
+    racecheck.write_report(rc_out)
+    racecheck.check()
+    print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
+          f"(parent; report at {rc_out})")
+    print("LAUNCH DRILL GREEN")
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,22 @@ env JAX_PLATFORMS=cpu \
     PS_RACECHECK_OUT="${PS_RACECHECK_OUT:-/tmp/ps_racecheck.json}" \
     python scripts/check_ps.py
 
+echo "== multi-host launch drill (fake cluster / host death / respawn) =="
+# supervised launch over a FakeTransport "cluster" of 3 virtual hosts:
+# an ElasticLauncher (tracker + JobSet) runs a 4-rank elastic fit;
+# launch_host:kill=h1 downs one host mid-round, the JobSet respawns
+# the lost rank on a surviving host, the replacement reclaims its
+# tracker rank and replays — result must be byte-identical to an
+# uninterrupted baseline.  Stage 2 scales a LauncherScaler-backed
+# serving fleet 2 -> 4 replicas across fake hosts with zero dropped
+# loadgen requests.  Everything runs under DMLC_LOCKCHECK=1 +
+# DMLC_RACECHECK=1 with zero order cycles and zero happens-before
+# races; racecheck JSON archived (doc/distributed.md "Multi-host
+# launch").
+env JAX_PLATFORMS=cpu \
+    LAUNCH_RACECHECK_OUT="${LAUNCH_RACECHECK_OUT:-/tmp/launch_racecheck.json}" \
+    python scripts/check_launch.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

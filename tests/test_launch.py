@@ -1,0 +1,375 @@
+"""Tests for the multi-host launch subsystem (``dmlc_core_tpu.launch``).
+
+Transports are exercised with real local subprocesses (LocalTransport,
+FakeTransport), a stub ``ssh`` binary (SSHTransport — the remote command
+line is what matters, not a network), and dry-run manifests
+(K8sTransport).  JobSet supervision — respawn budgets, targeted kill,
+scale-out, teardown — runs against those same transports, so everything
+here proves the exact code paths ``scripts/check_launch.py`` drills.
+"""
+
+import os
+import signal
+import stat
+import sys
+import time
+
+import pytest
+
+from dmlc_core_tpu.base import faultinject
+from dmlc_core_tpu.base.logging import Error
+from dmlc_core_tpu.launch import (FakeTransport, JobSet, K8sTransport,
+                                  LaunchTimeout, LocalTransport,
+                                  SSHTransport, TransportError,
+                                  jobset_from_opts, transport_from_opts)
+from dmlc_core_tpu.tracker.opts import get_opts
+
+PY = sys.executable
+ENVS = {"DMLC_TRACKER_URI": "10.0.0.1", "DMLC_TRACKER_PORT": "9091",
+        "DMLC_NUM_WORKER": "4"}
+
+
+def _wait_code(transport, handle, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        code = transport.poll(handle)
+        if code is not None:
+            return code
+        time.sleep(0.02)
+    raise AssertionError(f"worker {handle} never exited")
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+class TestLocalTransport:
+    def test_spawn_env_overlay_and_log_tail(self, tmp_path):
+        tr = LocalTransport(log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c", "import os; print('X is', os.environ['X'])"],
+                     {"X": "42"}, "localhost", label="w0")
+        assert _wait_code(tr, h) == 0
+        assert tr.env_of(h) == {"X": "42"}          # overlay, not os.environ
+        assert "X is 42" in tr.log_tail(h)
+        assert h.log_path == str(tmp_path / "w0.log")
+
+    def test_signal_terminates(self, tmp_path):
+        tr = LocalTransport(log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c", "import time; time.sleep(30)"], {},
+                     "localhost")
+        assert tr.poll(h) is None
+        tr.signal(h, signal.SIGTERM)
+        assert _wait_code(tr, h) == -signal.SIGTERM
+
+    def test_pdeathsig_kills_orphans(self, tmp_path):
+        """The fire-and-forget fix: a worker whose spawning process is
+        SIGKILLed must die too (PR_SET_PDEATHSIG), not leak."""
+        if not sys.platform.startswith("linux"):
+            pytest.skip("pdeathsig is Linux-only")
+        pidfile = tmp_path / "worker.pid"
+        # middle process spawns a sleeper through LocalTransport, writes
+        # its pid, then blocks forever; we SIGKILL the middle process and
+        # the sleeper must disappear with it
+        middle = tmp_path / "middle.py"
+        middle.write_text(
+            "import sys, time\n"
+            f"sys.path.insert(0, {os.getcwd()!r})\n"
+            "from dmlc_core_tpu.launch import LocalTransport\n"
+            f"tr = LocalTransport(log_dir={str(tmp_path)!r})\n"
+            f"h = tr.spawn([{PY!r}, '-c', 'import time; time.sleep(60)'],\n"
+            "              {}, 'localhost')\n"
+            f"open({str(pidfile)!r}, 'w').write(str(h.pid))\n"
+            "time.sleep(60)\n")
+        import subprocess
+        mid = subprocess.Popen([PY, str(middle)])
+        deadline = time.time() + 15
+        while not pidfile.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert pidfile.exists(), "middle process never spawned the worker"
+        worker_pid = int(pidfile.read_text())
+        os.kill(worker_pid, 0)                      # alive
+        mid.kill()
+        mid.wait(timeout=10)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(worker_pid, 0)
+            except ProcessLookupError:
+                return                              # orphan died: fixed
+            time.sleep(0.05)
+        os.kill(worker_pid, signal.SIGKILL)
+        raise AssertionError("worker leaked past its dead parent")
+
+
+class TestSSHTransport:
+    def test_build_argv_shape(self):
+        tr = SSHTransport(["h0", "h1"], cwd="/work dir", ssh_binary="ssh")
+        argv = tr.build_argv("h1", ["python", "t.py", "--a b"],
+                            {"DMLC_TASK_ID": "1", "V": "x y"})
+        assert argv[0] == "ssh" and argv[1] == "-tt"
+        assert argv[-2] == "h1"
+        remote = argv[-1]
+        assert remote.startswith("cd '/work dir' && env ")
+        assert "DMLC_TASK_ID=1" in remote and "V='x y'" in remote
+        assert remote.endswith("python t.py '--a b'")
+        assert "BatchMode=yes" in argv
+
+    def test_stub_ssh_runs_remote_command(self, tmp_path):
+        """A stub ``ssh`` that execs its last argument locally proves the
+        whole spawn path (env overlay travels inside the command line)."""
+        stub = tmp_path / "ssh"
+        stub.write_text('#!/bin/bash\nexec bash -c "${@: -1}"\n')
+        stub.chmod(stub.stat().st_mode | stat.S_IXUSR)
+        out = tmp_path / "out.txt"
+        tr = SSHTransport(["hostA"], cwd=str(tmp_path),
+                          ssh_binary=str(stub), log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c",
+                      f"import os; open({str(out)!r}, 'w')"
+                      ".write(os.environ['X'] + ' ' + os.getcwd())"],
+                     {"X": "7"}, "hostA", label="r0")
+        assert _wait_code(tr, h) == 0
+        val, cwd = out.read_text().split(" ", 1)
+        assert val == "7"
+        assert os.path.realpath(cwd) == os.path.realpath(str(tmp_path))
+
+
+class TestFakeTransport:
+    def test_fail_host_kills_and_refuses(self, tmp_path):
+        tr = FakeTransport(hosts=["h0", "h1"], log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c", "import time; time.sleep(30)"], {}, "h0")
+        tr.fail_host("h0")
+        assert _wait_code(tr, h) == -signal.SIGKILL
+        assert not tr.host_alive("h0") and tr.down_hosts() == ["h0"]
+        with pytest.raises(TransportError, match="down"):
+            tr.spawn([PY, "-c", "pass"], {}, "h0")
+        tr.restore_host("h0")
+        assert tr.host_alive("h0")
+
+    def test_injected_spawn_error(self, tmp_path):
+        tr = FakeTransport(log_dir=str(tmp_path))
+        with faultinject.inject("launch_spawn:error:n=1"):
+            with pytest.raises(TransportError, match="injected spawn"):
+                tr.spawn([PY, "-c", "pass"], {}, "h0")
+            h = tr.spawn([PY, "-c", "pass"], {}, "h0")   # n=1: once only
+        assert _wait_code(tr, h) == 0
+
+    def test_injected_host_kill_on_tick(self, tmp_path):
+        tr = FakeTransport(hosts=["h0", "h1"], log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c", "import time; time.sleep(30)"], {}, "h1")
+        with faultinject.inject("launch_host:kill=h1:n=1"):
+            tr.tick()
+        assert _wait_code(tr, h) == -signal.SIGKILL
+        assert tr.down_hosts() == ["h1"]
+
+
+class TestK8sTransport:
+    def test_manifest_snapshot(self):
+        tr = K8sTransport("img:1", jobname="My Job", dry_run=True,
+                          worker_cores=2, worker_memory_mb=512)
+        m = tr.render(["python", "t.py"], {"DMLC_TASK_ID": "0"}, "J-r0-a0")
+        assert m["kind"] == "Job"
+        assert m["metadata"]["name"] == "my-job-j-r0-a0"   # RFC-1123
+        spec = m["spec"]
+        assert spec["completions"] == 1 and spec["parallelism"] == 1
+        # the JobSet is the one restart authority
+        assert spec["backoffLimit"] == 0
+        c = spec["template"]["spec"]["containers"][0]
+        assert c["image"] == "img:1" and c["command"] == ["python", "t.py"]
+        assert {"name": "DMLC_TASK_ID", "value": "0"} in c["env"]
+        assert c["resources"]["requests"]["memory"] == "512Mi"
+
+    def test_dry_run_lifecycle(self):
+        tr = K8sTransport("img:1", dry_run=True, slots=3)
+        assert tr.hosts() == ["k8s"] * 3
+        h = tr.spawn(["python", "t.py"], {}, "k8s", label="r0")
+        assert tr.poll(h) == 0 and len(tr.manifests) == 1
+
+    def test_dry_run_signal_records_code(self):
+        tr = K8sTransport("img:1", dry_run=True)
+        h = tr.spawn(["x"], {}, "k8s")
+        h.extra.pop("exit_code")        # pretend the job is still running
+        tr.signal(h, signal.SIGTERM)
+        assert tr.poll(h) == 128 + signal.SIGTERM
+
+
+# ---------------------------------------------------------------------------
+# JobSet supervision
+# ---------------------------------------------------------------------------
+
+class TestJobSet:
+    def test_worker_env_overlay(self):
+        js = JobSet(["x"], 4, envs=ENVS, name="j")
+        env = js.worker_env(2, attempt=1)
+        assert env == {**ENVS, "DMLC_TASK_ID": "2", "DMLC_ROLE": "worker",
+                       "DMLC_NUM_ATTEMPT": "1"}
+        js2 = JobSet(["x"], 3, env_for=lambda r, a: {"EXTRA": f"{r}.{a}"})
+        env2 = js2.worker_env(1)
+        assert env2["DMLC_NUM_WORKER"] == "3" and env2["EXTRA"] == "1.0"
+
+    def test_run_happy_path(self, tmp_path):
+        js = JobSet([PY, "-c", "import os; exit(int(os.environ"
+                     "['DMLC_TASK_ID']) > 2)"], 3,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    monitor_s=0.05)
+        assert js.run(timeout=30) == [0, 0, 0]
+        kinds = [e["event"] for e in js.events()]
+        assert kinds.count("spawn") == 3 and kinds[-1] == "teardown"
+        st = js.stats()
+        assert st["backend"] == "local" and st["respawns"] == 0
+        assert st["spawns"] == 3 and st["spawn_ms_p95"] > 0
+
+    def test_respawn_then_success(self, tmp_path):
+        prog = ("import os, sys; "
+                "sys.exit(0 if int(os.environ['DMLC_NUM_ATTEMPT']) >= 1 "
+                "else 3)")
+        js = JobSet([PY, "-c", prog], 2,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    restart_limit=2, monitor_s=0.05)
+        assert js.run(timeout=30) == [0, 0]
+        assert js.respawns() == 2
+
+    def test_restart_budget_gives_up(self, tmp_path):
+        js = JobSet([PY, "-c", "raise SystemExit(5)"], 1,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    restart_limit=1, monitor_s=0.05)
+        assert js.run(timeout=30) == [5]
+        kinds = [e["event"] for e in js.events()]
+        assert "giveup" in kinds and js.respawns() == 1
+
+    def test_targeted_kill_no_respawn(self, tmp_path):
+        js = JobSet([PY, "-c", "import time; time.sleep(30)"], 2,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    restart_limit=3, monitor_s=0.05)
+        js.launch()
+        try:
+            js.kill(1)                          # intentional stop
+            deadline = time.time() + 10
+            while js.alive_count() > 1 and time.time() < deadline:
+                time.sleep(0.05)
+            time.sleep(0.3)                     # would-be respawn window
+            assert js.respawns() == 0
+            assert js.alive_count() == 1
+        finally:
+            js.shutdown()
+
+    def test_targeted_kill_with_respawn(self, tmp_path):
+        js = JobSet([PY, "-c", "import time; time.sleep(30)"], 1,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    restart_limit=3, monitor_s=0.05)
+        js.launch()
+        try:
+            first = js.rank_host(0)
+            js.kill(0, sig=signal.SIGKILL, respawn=True)
+            deadline = time.time() + 15
+            while js.respawns() == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert js.respawns() == 1 and js.rank_host(0) == first
+        finally:
+            js.shutdown()
+
+    def test_add_rank_scale_out(self, tmp_path):
+        js = JobSet([PY, "-c", "import time; time.sleep(30)"], 1,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    monitor_s=0.05)
+        js.launch()
+        try:
+            assert js.add_rank() == 1
+            assert js.add_rank() == 2
+            deadline = time.time() + 10
+            while js.alive_count() < 3 and time.time() < deadline:
+                time.sleep(0.05)
+            assert js.alive_count() == 3
+        finally:
+            js.shutdown()
+        assert js.stats()["ranks"][2]["done"]
+
+    def test_wait_timeout(self, tmp_path):
+        js = JobSet([PY, "-c", "import time; time.sleep(30)"], 1,
+                    transport=LocalTransport(log_dir=str(tmp_path)),
+                    monitor_s=0.05)
+        js.launch()
+        try:
+            with pytest.raises(LaunchTimeout):
+                js.wait(timeout=0.3)
+        finally:
+            js.shutdown()
+
+    def test_host_death_respawns_on_survivor(self, tmp_path):
+        tr = FakeTransport(hosts=["h0", "h1", "h2"], log_dir=str(tmp_path))
+        with faultinject.inject("launch_host:kill=h1:after=3:n=1"):
+            js = JobSet([PY, "-c", "import time; time.sleep(0.6)"], 4,
+                        transport=tr, restart_limit=2, monitor_s=0.05)
+            codes = js.run(timeout=60)
+        assert codes == [0, 0, 0, 0]
+        assert js.respawns() >= 1 and tr.down_hosts() == ["h1"]
+        # rank 1 was placed on h1; its replacement must be elsewhere
+        assert js.stats()["ranks"][1]["host"] in ("h0", "h2")
+
+    def test_spawn_error_consumes_budget_then_recovers(self, tmp_path):
+        tr = FakeTransport(hosts=["a", "b"], log_dir=str(tmp_path))
+        with faultinject.inject("launch_spawn:error:n=1"):
+            js = JobSet([PY, "-c", "pass"], 2, transport=tr,
+                        restart_limit=2, monitor_s=0.05)
+            codes = js.run(timeout=30)
+        assert codes == [0, 0]
+        kinds = [e["event"] for e in js.events()]
+        assert "spawn_error" in kinds and "respawn" in kinds
+
+
+# ---------------------------------------------------------------------------
+# dmlc-submit options → JobSet configurations (golden per backend)
+# ---------------------------------------------------------------------------
+
+class TestSubmitConfigs:
+    def test_local_golden_env(self):
+        opts, cmd = get_opts(["--cluster", "local", "-n", "2", "--",
+                              "python", "t.py"])
+        js = jobset_from_opts(opts, cmd, ENVS)
+        assert js.transport.name == "local"
+        assert js.worker_env(0) == {**ENVS, "DMLC_TASK_ID": "0",
+                                    "DMLC_ROLE": "worker",
+                                    "DMLC_NUM_ATTEMPT": "0"}
+
+    def test_ssh_golden_env_and_slots(self, tmp_path):
+        hf = tmp_path / "hosts"
+        hf.write_text("# fleet\nh0:2\nh1\n")
+        opts, cmd = get_opts(["--cluster", "ssh", "-n", "3",
+                              "--host-file", str(hf), "--", "python", "t.py"])
+        js = jobset_from_opts(opts, cmd, ENVS)
+        assert js.transport.name == "ssh"
+        assert js.transport.hosts() == ["h0", "h0", "h1"]
+        assert js.worker_env(1) == {**ENVS, "DMLC_TASK_ID": "1",
+                                    "DMLC_ROLE": "worker",
+                                    "DMLC_NUM_ATTEMPT": "0"}
+
+    def test_ssh_requires_host_file(self):
+        opts, cmd = get_opts(["--cluster", "ssh", "-n", "1", "--", "x"])
+        with pytest.raises(Error, match="host-file"):
+            transport_from_opts(opts)
+
+    def test_kubernetes_golden_env_and_manifest(self):
+        opts, cmd = get_opts(["--cluster", "kubernetes", "-n", "2",
+                              "--image", "img:1", "--jobname", "train",
+                              "--worker-cores", "4", "--worker-memory",
+                              "2048", "--max-attempts", "2", "--dry-run",
+                              "--", "python", "t.py"])
+        js = jobset_from_opts(opts, cmd, ENVS,
+                              extra_env={"JAX_PLATFORMS": "tpu"})
+        tr = js.transport
+        assert tr.name == "k8s" and tr.dry_run
+        env0 = js.worker_env(0)
+        assert env0 == {**ENVS, "JAX_PLATFORMS": "tpu",
+                        "DMLC_TASK_ID": "0", "DMLC_ROLE": "worker",
+                        "DMLC_NUM_ATTEMPT": "0"}
+        m = tr.render(cmd, env0, "train-r0-a0")
+        assert m["metadata"]["name"] == "train-train-r0-a0"
+        assert m["spec"]["backoffLimit"] == 0
+        c = m["spec"]["template"]["spec"]["containers"][0]
+        assert c["resources"]["requests"]["cpu"] == "4"
+        # --max-attempts 2 → 1 JobSet respawn (attempt 0 is the launch)
+        assert js._restart_limit == 1
+
+    def test_unsupervised_cluster_rejected(self):
+        opts, _ = get_opts(["--cluster", "slurm", "-n", "1", "--", "x"])
+        with pytest.raises(ValueError, match="not JobSet-supervised"):
+            transport_from_opts(opts)

@@ -108,19 +108,63 @@ class TestKubernetes:
 
 
 class TestLauncher:
+    def test_task_id_each_rank_var(self):
+        # every cluster-manager rank variable resolves on its own
+        for var in launcher._RANK_VARS:
+            assert launcher.task_id_from_env({var: "6"}) == 6, var
+
     def test_task_id_priority(self):
         assert launcher.task_id_from_env({"DMLC_TASK_ID": "5",
                                           "SLURM_PROCID": "9"}) == 5
         assert launcher.task_id_from_env({"OMPI_COMM_WORLD_RANK": "3"}) == 3
+        assert launcher.task_id_from_env({"PMI_RANK": "1",
+                                          "JOB_COMPLETION_INDEX": "8"}) == 1
         assert launcher.task_id_from_env({"SLURM_PROCID": "2"}) == 2
         assert launcher.task_id_from_env({"JOB_COMPLETION_INDEX": "7"}) == 7
+        # full precedence chain: earlier var always wins
+        env = {v: str(i) for i, v in enumerate(launcher._RANK_VARS)}
+        for i, var in enumerate(launcher._RANK_VARS):
+            assert launcher.task_id_from_env(env) == i
+            del env[var]
         assert launcher.task_id_from_env({}) == 0
+        assert launcher.task_id_from_env({"DMLC_TASK_ID": "  "}) == 0
+
+    def test_task_id_required_checks(self):
+        from dmlc_core_tpu.base.logging import Error
+        with pytest.raises(Error, match="no rank variable"):
+            launcher.task_id_from_env({}, required=True)
+        assert launcher.task_id_from_env({"PMI_RANK": "4"},
+                                         required=True) == 4
 
     def test_prepare_env_fills_abi(self):
         env = launcher.prepare_env({"PMI_RANK": "4"})
         assert env["DMLC_TASK_ID"] == "4"
         assert env["DMLC_ROLE"] == "worker"
         assert env["DMLC_NUM_ATTEMPT"] == "0"
+
+
+class TestHostFile:
+    def test_comments_blanks_and_slots(self, tmp_path):
+        from dmlc_core_tpu.tracker.ssh import read_host_file
+        hf = tmp_path / "hosts"
+        hf.write_text("# edge pool\n\nh0:2\nh1\nuser@h2:1 extra-col\n")
+        assert read_host_file(str(hf)) == ["h0", "h0", "h1", "user@h2"]
+
+    def test_empty_file_errors(self, tmp_path):
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.tracker.ssh import read_host_file
+        hf = tmp_path / "hosts"
+        hf.write_text("# only comments\n\n")
+        with pytest.raises(Error, match="no hosts"):
+            read_host_file(str(hf))
+
+    def test_bad_slot_count_errors(self, tmp_path):
+        from dmlc_core_tpu.base.logging import Error
+        from dmlc_core_tpu.tracker.ssh import read_host_file
+        hf = tmp_path / "hosts"
+        hf.write_text("h0:0\n")
+        with pytest.raises(Error, match="bad slot count"):
+            read_host_file(str(hf))
 
 
 class TestOpts:
