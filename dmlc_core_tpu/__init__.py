@@ -84,6 +84,16 @@ if _racecheck.env_enabled():
     # check() (see doc/static_analysis.md).
     _racecheck.install()
 
+from dmlc_core_tpu.base import leakcheck as _leakcheck
+
+if _leakcheck.env_enabled():
+    # DMLC_LEAKCHECK=1: every socket/thread/subprocess/tempfile created
+    # through repo code after this point is traced with its creation
+    # stack; whatever is still live at drill exit is reported via
+    # base.leakcheck.leaks()/check() (see doc/static_analysis.md).
+    # Installed AFTER racecheck so the Thread.start hooks chain.
+    _leakcheck.install()
+
 from dmlc_core_tpu.base.logging import (  # noqa: F401
     Error,
     LOG,

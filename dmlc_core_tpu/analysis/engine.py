@@ -45,6 +45,8 @@ ALL_RULES: Tuple[str, ...] = (
     "jit-purity",
     "knob-registry", "knob-doc",
     "metric-registry", "metric-doc",
+    "resource-leak", "thread-lifecycle",
+    "collective-discipline", "wire-schema",
 )
 
 #: directories walked relative to the repo root (mirrors scripts/lint.py)
@@ -253,7 +255,8 @@ def analyze(root: str,
     wall time lands in ``ctx.pass_seconds``."""
     # late imports: engine <-> passes would otherwise cycle
     from dmlc_core_tpu.analysis import (atomicity, blocking, jitpure,
-                                        locks, registries, style)
+                                        locks, protocol, registries,
+                                        resources, style)
 
     if files is None:
         files = default_files(root)
@@ -289,6 +292,10 @@ def analyze(root: str,
     if selected & {"knob-registry", "knob-doc", "metric-registry",
                    "metric-doc"}:
         _timed("registries", registries.run, ctx, selected)
+    if selected & {"resource-leak", "thread-lifecycle"}:
+        _timed("resources", resources.run, ctx, selected)
+    if selected & {"collective-discipline", "wire-schema"}:
+        _timed("protocol", protocol.run, ctx, selected)
     ctx.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
     return ctx
 
@@ -298,7 +305,8 @@ def rule_help(rule: str) -> Dict[str, str]:
     plus a minimal flagged/clean source pair.  Falls back to the pass
     module's docstring for rules without a curated example."""
     from dmlc_core_tpu.analysis import (atomicity, blocking, jitpure,
-                                        locks, registries, style)
+                                        locks, protocol, registries,
+                                        resources, style)
 
     if rule not in ALL_RULES:
         raise ValueError(f"unknown dmlcheck rule: {rule}")
@@ -309,6 +317,8 @@ def rule_help(rule: str) -> Dict[str, str]:
         "jit-purity": jitpure,
         "knob-registry": registries, "knob-doc": registries,
         "metric-registry": registries, "metric-doc": registries,
+        "resource-leak": resources, "thread-lifecycle": resources,
+        "collective-discipline": protocol, "wire-schema": protocol,
     }
     mod = owners[rule]
     entry = getattr(mod, "EXPLAIN", {}).get(rule)

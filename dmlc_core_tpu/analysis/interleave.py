@@ -763,7 +763,9 @@ def model_circuit_breaker(sched: Scheduler) -> None:
                 admitted.append(i)
 
         for i in range(3):
-            threading.Thread(target=prober, args=(i,)).start()
+            # sched.go() runs every model thread to completion — the
+            # scheduler is the join point for interleave scenarios
+            threading.Thread(target=prober, args=(i,)).start()  # dmlcheck: off:thread-lifecycle
         with sched.attr_points(CircuitBreaker):
             sched.go()
     assert len(admitted) == 1, (
@@ -839,7 +841,8 @@ def model_batcher_flush(sched: Scheduler) -> None:
                 th.join()
             b.close(drain=True)
 
-        threading.Thread(target=closer).start()
+        # sched.go() below runs the model thread to completion
+        threading.Thread(target=closer).start()  # dmlcheck: off:thread-lifecycle
         sched.go()
     assert sorted(i for i, _ in results) == [0, 1, 2], (
         f"requests lost or duplicated: {results}")
@@ -876,13 +879,14 @@ def model_registry_hot_swap(sched: Scheduler) -> None:
                     if staged:
                         reg.activate(v)
 
-            threading.Thread(target=publisher).start()
+            # sched.go() runs every model thread to completion
+            threading.Thread(target=publisher).start()  # dmlcheck: off:thread-lifecycle
             for k in range(2):
                 def reader() -> None:
                     for _ in range(3):
                         ver, runner = reg.current()
                         observed.append((ver, runner.model))
-                threading.Thread(target=reader).start()
+                threading.Thread(target=reader).start()  # dmlcheck: off:thread-lifecycle
             with sched.attr_points(registry_mod.ModelRegistry):
                 sched.go()
     finally:

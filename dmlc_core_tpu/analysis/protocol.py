@@ -1,0 +1,318 @@
+"""``collective-discipline`` and ``wire-schema`` passes.
+
+The distributed layers die in ways no unit test shows: a collective
+issued on *some* ranks wedges every rank (the others wait forever at
+the tracker), and a JSON header key one side sends but the other never
+reads silently drops a field — or hangs a worker — only when the two
+sides come from different versions.
+
+``collective-discipline``: a collective (``allreduce`` / ``allgather``
+/ ``broadcast`` / ``bcast`` / ``barrier`` / ``commit``) must be issued
+in rank-invariant order.  The pass flags collective calls lexically
+inside an ``if``/``else`` whose test reads a rank (``rank`` / ``wrank``
+/ ``grank`` / ``task_id`` names or a ``.rank()`` call) — both arms are
+rank-conditional: each runs on a complementary rank subset.  Functions
+*named* like a collective are exempt (transport implementations
+legitimately branch on rank inside ``def broadcast``).  Symmetric
+protocols where every rank provably reaches a matching call by a
+different path are the suppression case — annotate the site with
+``# dmlcheck: off:collective-discipline`` plus the pairing rationale.
+
+``wire-schema``: every literal message dict carrying a ``"cmd"`` key
+must use a command and header keys declared in the central
+``base/wire_schemas.py`` registry (parsed statically, so fixtures can
+ship their own copy); the transport's own framing keys
+(``WIRE_FRAMING``) are always allowed.  A dict whose ``cmd`` is
+dynamic is checked against the union of all declared keys.  The same
+contract covers the launch env ABI: ``DMLC_*`` keys *written into*
+worker environments under ``launch/`` or ``tracker/`` must be declared
+in ``ENV_ABI``.  Protocol drift thus fails lint at the sending site —
+the reminder to update registry and receiving side in the same change.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Optional, Set, Tuple
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+
+__all__ = ["run", "EXPLAIN"]
+
+_COLLECTIVES = {"allreduce", "allgather", "broadcast", "bcast", "barrier",
+                "commit"}
+_RANK_NAMES = {"rank", "wrank", "grank", "task_id"}
+_ENV_KEY_RE = re.compile(r"^DMLC_[A-Z0-9_]+$")
+_REGISTRY_REL = "dmlc_core_tpu/base/wire_schemas.py"
+
+EXPLAIN = {
+    "collective-discipline": {
+        "doc": "Collective call (allreduce/allgather/broadcast/barrier/"
+               "commit) under a rank-conditional branch — ranks that "
+               "skip it leave the others waiting at the tracker "
+               "forever.  Hoist the collective out of the branch, or "
+               "suppress with the rationale for why every rank reaches "
+               "a matching call.  Functions named like a collective "
+               "(transport implementations) are exempt.",
+        "flagged": (
+            "def save(coll, model):\n"
+            "    if coll.rank() == 0:\n"
+            "        write(model)\n"
+            "        coll.barrier('ckpt')   # ranks != 0 never arrive\n"),
+        "clean": (
+            "def save(coll, model):\n"
+            "    if coll.rank() == 0:\n"
+            "        write(model)\n"
+            "    coll.barrier('ckpt')       # every rank arrives\n"),
+    },
+    "wire-schema": {
+        "doc": "JSON message dict whose \"cmd\" or header keys are not "
+               "declared in base/wire_schemas.py (or a DMLC_* env key "
+               "injected by launch/tracker code that is missing from "
+               "ENV_ABI).  The registry is the wire contract: a key "
+               "only one side knows is protocol drift that surfaces as "
+               "a hang between client and server versions.",
+        "flagged": (
+            "# base/wire_schemas.py declares\n"
+            "#   'push': {'cmd', 'name', 'rank', 'clock'}\n"
+            "conn.request({'cmd': 'push', 'name': n,\n"
+            "              'momentum': m})   # undeclared key\n"),
+        "clean": (
+            "conn.request({'cmd': 'push', 'name': n, 'rank': r,\n"
+            "              'clock': c})      # declared schema\n"),
+    },
+}
+
+
+# -- registry loading (static, from the analyzed tree) ----------------------
+
+def _const_str_set(node: ast.expr) -> Optional[FrozenSet[str]]:
+    """``frozenset({...})`` / set / list / tuple of string constants."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "frozenset" and len(node.args) == 1):
+        node = node.args[0]
+    if not isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        return None
+    out = set()
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return frozenset(out)
+
+
+def _load_registry(ctx: AnalysisContext) -> Tuple[
+        Optional[Dict[str, FrozenSet[str]]], FrozenSet[str], FrozenSet[str]]:
+    """(COMMANDS, ENV_ABI, WIRE_FRAMING) parsed from the repo under
+    analysis — ``None`` commands when the registry file is absent."""
+    tree = None
+    for pf in ctx.files:
+        if pf.rel == _REGISTRY_REL and pf.tree is not None:
+            tree = pf.tree
+            break
+    if tree is None:
+        return None, frozenset(), frozenset()
+    commands: Dict[str, FrozenSet[str]] = {}
+    env_abi: FrozenSet[str] = frozenset()
+    framing: FrozenSet[str] = frozenset()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        else:
+            continue
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if "COMMANDS" in names and isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                keys = _const_str_set(v)
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and keys is not None):
+                    commands[k.value] = keys
+        elif "ENV_ABI" in names:
+            env_abi = _const_str_set(value) or frozenset()
+        elif "WIRE_FRAMING" in names:
+            framing = _const_str_set(value) or frozenset()
+    return commands, env_abi, framing
+
+
+# -- wire-schema ------------------------------------------------------------
+
+def _dict_cmd(node: ast.Dict) -> Tuple[bool, Optional[str], Set[str]]:
+    """(has literal "cmd" key, cmd value if constant, all literal keys)."""
+    has_cmd = False
+    cmd: Optional[str] = None
+    keys: Set[str] = set()
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue                        # **spread / computed keys
+        keys.add(k.value)
+        if k.value == "cmd":
+            has_cmd = True
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                cmd = v.value
+    return has_cmd, cmd, keys
+
+
+def _check_wire(ctx: AnalysisContext, pf: ParsedFile,
+                commands: Optional[Dict[str, FrozenSet[str]]],
+                framing: FrozenSet[str]) -> None:
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        has_cmd, cmd, keys = _dict_cmd(node)
+        if not has_cmd:
+            continue
+        if commands is None:
+            ctx.add(pf, node.lineno, "wire-schema",
+                    "message dict sent without a wire registry — create "
+                    "base/wire_schemas.py and declare its cmd/keys",
+                    key="registry-missing")
+            continue
+        if cmd is not None:
+            if cmd not in commands:
+                ctx.add(pf, node.lineno, "wire-schema",
+                        f"message cmd {cmd!r} is not declared in "
+                        f"base/wire_schemas.py", key=f"cmd:{cmd}")
+                continue
+            allowed = commands[cmd] | framing
+            for k in sorted(keys - allowed):
+                ctx.add(pf, node.lineno, "wire-schema",
+                        f"key {k!r} is not in the declared schema for "
+                        f"cmd {cmd!r} (allowed: "
+                        f"{sorted(commands[cmd])})", key=f"{cmd}.{k}")
+        else:
+            # dynamic cmd (e.g. start|recover handshakes): every literal
+            # key must at least exist in some declared command
+            vocab = framing.union(*commands.values()) if commands \
+                else framing
+            for k in sorted(keys - vocab):
+                ctx.add(pf, node.lineno, "wire-schema",
+                        f"key {k!r} (dynamic cmd) appears in no declared "
+                        f"command schema in base/wire_schemas.py",
+                        key=f"dynamic.{k}")
+
+
+def _check_env_abi(ctx: AnalysisContext, pf: ParsedFile,
+                   env_abi: FrozenSet[str]) -> None:
+    def flag(line: int, name: str) -> None:
+        ctx.add(pf, line, "wire-schema",
+                f"env key {name!r} is injected into a worker "
+                f"environment but is not declared in "
+                f"base/wire_schemas.py ENV_ABI", key=f"env:{name}")
+
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)
+                        and _ENV_KEY_RE.match(t.slice.value)
+                        and t.slice.value not in env_abi):
+                    flag(node.lineno, t.slice.value)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault" and node.args):
+            a0 = node.args[0]
+            if (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+                    and _ENV_KEY_RE.match(a0.value)
+                    and a0.value not in env_abi):
+                flag(node.lineno, a0.value)
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and _ENV_KEY_RE.match(k.value)
+                        and k.value not in env_abi):
+                    flag(k.lineno, k.value)
+
+
+# -- collective-discipline --------------------------------------------------
+
+def _reads_rank(test: ast.expr) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_NAMES:
+            return True
+    return False
+
+
+class _RankBranchScanner(ast.NodeVisitor):
+    """Flag collective calls under rank-conditional branches within ONE
+    function (does not descend into nested defs/classes)."""
+
+    def __init__(self, ctx: AnalysisContext, pf: ParsedFile,
+                 fname: str) -> None:
+        self.ctx = ctx
+        self.pf = pf
+        self.fname = fname
+        self.depth = 0                      # rank-conditional nesting
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass                                # own walk
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        pass
+
+    def visit_If(self, node: ast.If) -> None:
+        ranked = _reads_rank(node.test)
+        self.visit(node.test)
+        if ranked:
+            self.depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        if ranked:
+            self.depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.depth > 0:
+            name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+            if name in _COLLECTIVES:
+                self.ctx.add(
+                    self.pf, node.lineno, "collective-discipline",
+                    f"{self.fname}() issues collective {name!r} under a "
+                    f"rank-conditional branch — ranks that skip it wedge "
+                    f"the world; hoist it or suppress with the pairing "
+                    f"rationale", key=f"{self.fname}:{name}")
+        self.generic_visit(node)
+
+
+def _check_collectives(ctx: AnalysisContext, pf: ParsedFile) -> None:
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in _COLLECTIVES:
+            continue                        # transport implementations
+        sc = _RankBranchScanner(ctx, pf, node.name)
+        for stmt in node.body:
+            sc.visit(stmt)
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    """Run the protocol passes over every parsed repo file."""
+    wire = "wire-schema" in selected
+    coll = "collective-discipline" in selected
+    if not (wire or coll):
+        return
+    commands, env_abi, framing = _load_registry(ctx) if wire \
+        else (None, frozenset(), frozenset())
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")
+                or pf.rel == _REGISTRY_REL):
+            continue
+        if coll:
+            _check_collectives(ctx, pf)
+        if wire:
+            _check_wire(ctx, pf, commands, framing)
+            if (pf.rel.startswith("dmlc_core_tpu/launch/")
+                    or pf.rel.startswith("dmlc_core_tpu/tracker/")):
+                _check_env_abi(ctx, pf, env_abi)

@@ -1,0 +1,558 @@
+"""``resource-leak`` and ``thread-lifecycle`` passes.
+
+The process-spawning subsystems (tracker, PS client/server, launch
+transports, fleet loadgen) hold OS resources whose lifetime the type
+system never sees: a socket that misses its ``close()`` wedges a port,
+an unwaited child is a zombie, an unjoined thread can segfault
+interpreter teardown.  These passes prove acquisition *shape*
+statically; ``base/leakcheck.py`` is the dynamic companion that
+catches whatever shape analysis cannot.
+
+``resource-leak``: an acquisition (``socket.socket`` /
+``create_connection`` / ``Popen`` / ``NamedTemporaryFile`` /
+``TemporaryFile`` / ``mkstemp`` / builtin ``open``) must reach one of
+the accepted lifecycle shapes:
+
+* a ``with`` statement (directly or via the bound name);
+* an explicit release call on the name (``.close/.terminate/.kill/
+  .wait/.join/.shutdown/.stop/.release``) anywhere in the function —
+  try/finally placement is the caller's taste, not the lint's;
+* **ownership transfer**: the name is returned/yielded, passed as a
+  call argument (factories hand resources to owners — registries,
+  handles, thread targets), aliased, or stored into a container/
+  attribute;
+* **registered teardown**: ``self.<attr> = acquisition()`` is clean
+  when the class declares a teardown method (``close``/``stop``/
+  ``shutdown``/``release``/``terminate``/``join``/``__exit__``/
+  ``__del__``) that owns the attribute's lifetime.
+
+A bare ``socket.socket()`` / ``mkstemp()`` expression statement
+discards the only handle — always flagged.
+
+``thread-lifecycle``: a ``threading.Thread`` must be joinable and
+joined, or daemon *and* lock-free:
+
+* non-daemon thread with no reachable ``join()`` (on the name, via an
+  alias, a ``for v in threads: v.join()`` loop, or — for
+  ``self.<attr>`` threads — anywhere in the class) and no ownership
+  transfer → flagged: interpreter exit blocks on it;
+* ``Thread(...).start()`` chained fire-and-forget → never joinable;
+* a **daemon** thread whose target (resolved transitively through
+  same-class methods) acquires one of the class's locks → flagged
+  unless joined: daemonic death at interpreter teardown can leave the
+  lock held while non-daemon threads still want it.
+
+Suppress deliberate detached threads with
+``# dmlcheck: off:thread-lifecycle`` plus who reaps them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from dmlc_core_tpu.analysis.engine import AnalysisContext, ParsedFile
+from dmlc_core_tpu.analysis.locks import _class_lock_attrs, _self_attr
+
+__all__ = ["run", "EXPLAIN"]
+
+_RELEASE_METHODS = {"close", "terminate", "kill", "wait", "join",
+                    "shutdown", "stop", "release", "cancel"}
+_TEARDOWN_METHODS = {"close", "stop", "shutdown", "release", "terminate",
+                     "join", "__exit__", "__del__"}
+
+EXPLAIN = {
+    "resource-leak": {
+        "doc": "Socket/subprocess/tempfile/file acquired without a "
+               "with-block, an explicit release call, ownership "
+               "transfer (returned, passed on, stored) or a registered "
+               "class teardown — the OS handle outlives the code that "
+               "knew about it.  Factories that hand the resource to an "
+               "owner are clean by the transfer rule.",
+        "flagged": (
+            "def probe(host):\n"
+            "    s = socket.socket()\n"
+            "    s.connect((host, 80))\n"
+            "    data = s.recv(1)          # s never closed/escaped\n"
+            "    return data\n"),
+        "clean": (
+            "def probe(host):\n"
+            "    with socket.create_connection((host, 80)) as s:\n"
+            "        return s.recv(1)\n"),
+    },
+    "thread-lifecycle": {
+        "doc": "Non-daemon thread with no reachable join() (interpreter "
+               "exit blocks on it), a fire-and-forget "
+               "Thread(...).start() chain (never joinable), or a daemon "
+               "thread that acquires the class's locks (daemonic death "
+               "can strand the lock).  Joining with a bounded timeout "
+               "in the owner's close()/stop() is the accepted shape.",
+        "flagged": (
+            "class Server:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "        self._t.start()       # no join anywhere in class\n"),
+        "clean": (
+            "class Server:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "        self._t.start()\n"
+            "    def close(self):\n"
+            "        self._t.join(timeout=2.0)\n"),
+    },
+}
+
+
+def _call_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _receiver_name(func: ast.expr) -> str:
+    if not isinstance(func, ast.Attribute):
+        return ""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return ""
+
+
+def _acq_kind(node: ast.Call) -> str:
+    """Resource kind for an acquisition call, '' otherwise."""
+    name = _call_name(node.func)
+    recv = _receiver_name(node.func)
+    if name == "socket" and recv == "socket":
+        return "socket"
+    if name == "create_connection":
+        return "socket"
+    if name == "Popen":
+        return "subprocess"
+    if name in ("NamedTemporaryFile", "TemporaryFile"):
+        return "tempfile"
+    if name == "mkstemp":
+        return "mkstemp"
+    if name == "open" and recv == "" and isinstance(node.func, ast.Name):
+        return "file"
+    return ""
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    return _call_name(node.func) == "Thread"
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for k in node.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _kw_true(node: ast.Call, name: str) -> bool:
+    v = _kw(node, name)
+    return (isinstance(v, ast.Constant) and v.value is True)
+
+
+class _FuncEvidence(ast.NodeVisitor):
+    """Release/escape/join evidence for names within one function —
+    nested defs included (closures clean up for their owner)."""
+
+    def __init__(self) -> None:
+        self.released: Set[str] = set()      # <name>.close()-style
+        self.joined: Set[str] = set()        # <name>.join(...)
+        self.escaped: Set[str] = set()       # transferred/stored/aliased
+        self.with_names: Set[str] = set()    # with <name>:
+        #: list name -> loop vars iterating it (for v in threads:)
+        self.loop_vars: Dict[str, Set[str]] = {}
+        #: local alias -> self attr (t = self._thread)
+        self.self_alias: Dict[str, str] = {}
+        #: self attrs joined here (self._t.join() or via alias)
+        self.joined_attrs: Set[str] = set()
+        #: names set daemon post-hoc (t.daemon = True)
+        self.daemon_set: Set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _RELEASE_METHODS:
+                if isinstance(f.value, ast.Name):
+                    self.released.add(f.value.id)
+                    if f.attr == "join":
+                        self.joined.add(f.value.id)
+                        alias = self.self_alias.get(f.value.id)
+                        if alias:
+                            self.joined_attrs.add(alias)
+                attr = _self_attr(f.value)
+                if attr and f.attr == "join":
+                    self.joined_attrs.add(attr)
+        for sub in list(node.args) + [k.value for k in node.keywords]:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name):
+                    self.escaped.add(n.id)
+        self.generic_visit(node)
+
+    def _escape_value(self, value: Optional[ast.expr]) -> None:
+        if value is None:
+            return
+        if isinstance(value, ast.Name):
+            self.escaped.add(value.id)
+        elif isinstance(value, (ast.Tuple, ast.List)):
+            for e in value.elts:
+                self._escape_value(e)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name):
+                    self.escaped.add(n.id)
+        self.generic_visit(node)
+
+    def visit_Yield(self, node: ast.Yield) -> None:
+        if node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name):
+                    self.escaped.add(n.id)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Name):
+                self.with_names.add(item.context_expr.id)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if (isinstance(node.iter, ast.Name)
+                and isinstance(node.target, ast.Name)):
+            self.loop_vars.setdefault(node.iter.id,
+                                      set()).add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # alias (y = x / t = self._thread) and container/attr stores
+        if isinstance(node.value, ast.Name):
+            self.escaped.add(node.value.id)
+        elif isinstance(node.value, (ast.Tuple, ast.List)):
+            self._escape_value(node.value)
+        attr = _self_attr(node.value) if isinstance(node.value,
+                                                    ast.Attribute) else None
+        for t in node.targets:
+            if isinstance(t, ast.Name) and attr:
+                self.self_alias[t.id] = attr
+            if (isinstance(t, ast.Attribute)
+                    and t.attr == "daemon"
+                    and isinstance(t.value, ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True):
+                self.daemon_set.add(t.value.id)
+        self.generic_visit(node)
+
+    def list_joined(self, name: str) -> bool:
+        """True when some ``for v in <name>`` loop joins its loop var."""
+        return any(v in self.joined for v in self.loop_vars.get(name, ()))
+
+
+class _Acq:
+    """One acquisition site inside a function."""
+
+    __slots__ = ("kind", "line", "name", "form", "call")
+
+    def __init__(self, kind: str, line: int, name: Optional[str],
+                 form: str, call: ast.Call) -> None:
+        self.kind = kind
+        self.line = line
+        self.name = name       # bound local name, or self-attr name
+        self.form = form       # bare|name|self|tuple|comp|chain
+        self.call = call
+
+
+def _collect_acqs(fn: ast.AST) -> Tuple[List[_Acq], List[_Acq]]:
+    """(resource acquisitions, thread creations) at statement level of
+    one function — nested defs excluded (they get their own scan)."""
+    res: List[_Acq] = []
+    thr: List[_Acq] = []
+
+    def scan_stmts(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            _scan_stmt(stmt)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, field, None)
+                if not sub:
+                    continue
+                if field == "handlers":
+                    for h in sub:
+                        scan_stmts(h.body)
+                else:
+                    scan_stmts(sub)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pass            # with-acquisitions are clean by shape
+
+    def _scan_stmt(stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            kind = _acq_kind(call)
+            if kind:
+                res.append(_Acq(kind, stmt.lineno, None, "bare", call))
+            # Thread(...).start() chained fire-and-forget
+            f = call.func
+            if (isinstance(f, ast.Attribute) and f.attr == "start"
+                    and isinstance(f.value, ast.Call)
+                    and _is_thread_ctor(f.value)):
+                thr.append(_Acq("thread", stmt.lineno, None, "chain",
+                                f.value))
+        elif isinstance(stmt, ast.Assign) and isinstance(stmt.value,
+                                                         ast.Call):
+            call = stmt.value
+            kind = _acq_kind(call)
+            is_thr = _is_thread_ctor(call)
+            if not kind and not is_thr:
+                return
+            t = stmt.targets[0] if len(stmt.targets) == 1 else None
+            if isinstance(t, ast.Name):
+                acq = _Acq(kind or "thread", stmt.lineno, t.id, "name",
+                           call)
+                (thr if is_thr else res).append(acq)
+            elif t is not None and _self_attr(t):
+                acq = _Acq(kind or "thread", stmt.lineno, _self_attr(t),
+                           "self", call)
+                (thr if is_thr else res).append(acq)
+            elif (isinstance(t, ast.Tuple) and kind == "mkstemp"
+                    and t.elts and isinstance(t.elts[0], ast.Name)):
+                res.append(_Acq(kind, stmt.lineno, t.elts[0].id, "tuple",
+                                call))
+        elif (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, (ast.ListComp,
+                                            ast.GeneratorExp))
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            elt = stmt.value.elt
+            if isinstance(elt, ast.Call) and _is_thread_ctor(elt):
+                thr.append(_Acq("thread", stmt.lineno,
+                                stmt.targets[0].id, "comp", elt))
+
+    body = getattr(fn, "body", [])
+    scan_stmts(body)
+    return res, thr
+
+
+# -- daemon-owns-locks resolution -------------------------------------------
+
+def _target_method(call: ast.Call) -> Optional[str]:
+    """``Thread(target=self._foo)`` → ``"_foo"`` (same-class methods
+    only — module-level targets own no class locks)."""
+    v = _kw(call, "target")
+    if v is not None:
+        return _self_attr(v)
+    return None
+
+
+def _method_acquires_locks(cls_methods: Dict[str, ast.AST],
+                           lock_attrs: Set[str], method: str,
+                           visited: Optional[Set[str]] = None) -> bool:
+    """True when ``method`` (transitively through same-class calls)
+    enters one of the class's locks."""
+    if visited is None:
+        visited = set()
+    if method in visited or method not in cls_methods:
+        return False
+    visited.add(method)
+    fn = cls_methods[method]
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if _self_attr(ce) in lock_attrs:
+                    return True
+                if (isinstance(ce, ast.Call)
+                        and _self_attr(ce.func) in lock_attrs):
+                    return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+                and _self_attr(node.func.value) in lock_attrs):
+            return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            if _method_acquires_locks(cls_methods, lock_attrs,
+                                      node.func.attr, visited):
+                return True
+    return False
+
+
+# -- per-class / per-function checks ----------------------------------------
+
+def _fn_label(stack: List[str], fn_name: str) -> str:
+    return ".".join(stack + [fn_name])
+
+
+def _check_function(ctx: AnalysisContext, pf: ParsedFile, fn: ast.AST,
+                    label: str, cls: Optional[ast.ClassDef],
+                    cls_methods: Dict[str, ast.AST],
+                    cls_teardown: bool, cls_joined_attrs: Set[str],
+                    lock_attrs: Set[str], selected: Set[str]) -> None:
+    res, thr = _collect_acqs(fn)
+    if not res and not thr:
+        return
+    ev = _FuncEvidence()
+    for stmt in getattr(fn, "body", []):
+        ev.visit(stmt)
+
+    if "resource-leak" in selected:
+        for a in res:
+            if a.form == "bare":
+                ctx.add(pf, a.line, "resource-leak",
+                        f"{label}() discards a freshly acquired "
+                        f"{a.kind} (bare expression — the only handle "
+                        f"is lost)", key=f"{label}:bare-{a.kind}")
+            elif a.form in ("name", "tuple"):
+                assert a.name is not None
+                if (a.name in ev.released or a.name in ev.escaped
+                        or a.name in ev.with_names):
+                    continue
+                ctx.add(pf, a.line, "resource-leak",
+                        f"{label}() acquires {a.kind} {a.name!r} but "
+                        f"never closes, transfers or stores it — the "
+                        f"handle leaks when the function returns",
+                        key=f"{label}:{a.name}")
+            elif a.form == "self":
+                if cls_teardown:
+                    continue
+                ctx.add(pf, a.line, "resource-leak",
+                        f"{label}() stores {a.kind} in self.{a.name} "
+                        f"but {cls.name if cls else '<class>'} declares "
+                        f"no teardown (close/stop/shutdown/__del__) to "
+                        f"release it", key=f"{label}:self.{a.name}")
+
+    if "thread-lifecycle" in selected:
+        for a in thr:
+            daemon = _kw_true(a.call, "daemon") or (
+                a.name is not None and a.name in ev.daemon_set)
+            target = _target_method(a.call)
+            owns_locks = bool(
+                daemon and cls is not None and target is not None
+                and lock_attrs
+                and _method_acquires_locks(cls_methods, lock_attrs,
+                                           target))
+            tgt = target or (a.name or "thread")
+            if a.form == "chain":
+                if not daemon:
+                    ctx.add(pf, a.line, "thread-lifecycle",
+                            f"{label}() starts a fire-and-forget "
+                            f"non-daemon thread ({tgt}) — it can never "
+                            f"be joined and blocks interpreter exit",
+                            key=f"{label}:chain-{tgt}")
+                elif owns_locks:
+                    ctx.add(pf, a.line, "thread-lifecycle",
+                            f"{label}() starts a fire-and-forget daemon "
+                            f"thread whose target {target!r} acquires "
+                            f"the class's locks — daemonic death can "
+                            f"strand the lock; track and join it with a "
+                            f"bounded timeout",
+                            key=f"{label}:chain-{tgt}")
+            elif a.form in ("name", "comp"):
+                assert a.name is not None
+                joined = (a.name in ev.joined
+                          or (a.form == "comp"
+                              and ev.list_joined(a.name)))
+                if joined or a.name in ev.escaped:
+                    continue
+                if not daemon:
+                    ctx.add(pf, a.line, "thread-lifecycle",
+                            f"{label}() starts non-daemon thread "
+                            f"{a.name!r} with no reachable join()",
+                            key=f"{label}:{a.name}")
+                elif owns_locks:
+                    ctx.add(pf, a.line, "thread-lifecycle",
+                            f"{label}() starts daemon thread {a.name!r} "
+                            f"whose target {target!r} acquires the "
+                            f"class's locks, with no join()",
+                            key=f"{label}:{a.name}")
+            elif a.form == "self":
+                assert a.name is not None
+                joined = a.name in cls_joined_attrs
+                if joined:
+                    continue
+                if not daemon:
+                    ctx.add(pf, a.line, "thread-lifecycle",
+                            f"{label}() stores non-daemon thread in "
+                            f"self.{a.name} but no method of "
+                            f"{cls.name if cls else '<class>'} joins it",
+                            key=f"{label}:self.{a.name}")
+                elif owns_locks:
+                    ctx.add(pf, a.line, "thread-lifecycle",
+                            f"{label}() stores daemon thread "
+                            f"self.{a.name} whose target {target!r} "
+                            f"acquires the class's locks, and no method "
+                            f"of {cls.name if cls else '<class>'} joins "
+                            f"it — join with a bounded timeout in the "
+                            f"teardown path",
+                            key=f"{label}:self.{a.name}")
+
+
+def _class_joined_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Self attrs some method of ``cls`` joins (directly or via a local
+    alias or a ``for v in self._threads`` loop)."""
+    joined: Set[str] = set()
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        ev = _FuncEvidence()
+        for stmt in item.body:
+            ev.visit(stmt)
+        joined |= ev.joined_attrs
+        # for v in self._threads: v.join()
+        for node in ast.walk(item):
+            if (isinstance(node, ast.For)
+                    and isinstance(node.target, ast.Name)
+                    and _self_attr(node.iter)
+                    and node.target.id in ev.joined):
+                joined.add(_self_attr(node.iter))
+    return joined
+
+
+def _check_file(ctx: AnalysisContext, pf: ParsedFile,
+                selected: Set[str]) -> None:
+    def walk_body(body: List[ast.stmt], stack: List[str],
+                  cls: Optional[ast.ClassDef],
+                  cls_methods: Dict[str, ast.AST], cls_teardown: bool,
+                  cls_joined: Set[str], lock_attrs: Set[str]) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                methods = {
+                    m.name: m for m in node.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+                teardown = bool(_TEARDOWN_METHODS & set(methods))
+                joined = _class_joined_attrs(node)
+                locks = _class_lock_attrs(node)
+                walk_body(node.body, stack + [node.name], node, methods,
+                          teardown, joined, locks)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                label = _fn_label(stack, node.name)
+                _check_function(ctx, pf, node, label, cls, cls_methods,
+                                cls_teardown, cls_joined, lock_attrs,
+                                selected)
+                walk_body(node.body, stack + [node.name], cls,
+                          cls_methods, cls_teardown, cls_joined,
+                          lock_attrs)
+
+    walk_body(pf.tree.body, [], None, {}, False, set(), set())
+
+
+def run(ctx: AnalysisContext, selected: Set[str]) -> None:
+    """Run the resource passes over every parsed repo file."""
+    if not selected & {"resource-leak", "thread-lifecycle"}:
+        return
+    for pf in ctx.files:
+        if (pf.kind != "py" or pf.tree is None
+                or not pf.rel.startswith("dmlc_core_tpu/")):
+            continue
+        _check_file(ctx, pf, selected)

@@ -124,6 +124,12 @@ declare("DMLC_RACECHECK", "0",
         "import (implies lock tracing): shared-attribute accesses on "
         "the instrumented serving/tracker classes are checked for "
         "unordered cross-thread pairs (base/racecheck).", "observability")
+declare("DMLC_LEAKCHECK", "0",
+        "1 installs the resource-leak tracer at import: every "
+        "socket/thread/subprocess/tempfile created through repo code "
+        "is recorded with its creation stack, and whatever is still "
+        "live at drill exit is reported (base/leakcheck).",
+        "observability")
 declare("DMLC_INTERLEAVE_SCHEDULES", 200,
         "Schedule budget per model for the interleave model checker "
         "(analysis/interleave).", "observability")

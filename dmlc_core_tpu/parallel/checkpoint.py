@@ -280,7 +280,10 @@ def checkpoint(uri: str, state: Any, version: int = 0, sharded: bool = False,
         payload = host_leaves
     else:
         if coll.world_size() > 1 and coll.rank() != 0:
-            coll.barrier("ckpt")
+            # symmetric by construction: non-root ranks barrier here and
+            # return, rank 0 barriers at the end of the write path below
+            # — every rank reaches exactly one "ckpt" barrier
+            coll.barrier("ckpt")  # dmlcheck: off:collective-discipline
             return  # replicated state: rank 0 writes
         payload = jax.tree.map(_to_host, state)
         payload = jax.tree.flatten(payload)[0]

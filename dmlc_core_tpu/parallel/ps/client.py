@@ -141,6 +141,11 @@ class _ServerConn:
             self._sock.close()
         except OSError:
             pass
+        # the reader wakes on the closed socket; reap it bounded so
+        # failover churn does not accumulate dead reader threads that
+        # still own self._lock
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=2.0)
 
 
 class PSClient:

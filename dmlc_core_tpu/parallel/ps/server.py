@@ -187,6 +187,7 @@ class PSServer:
         self._cond = threading.Condition(self._lock)
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._serve_threads: List[threading.Thread] = []
         # shard state, all guarded by self._lock
         self._meta: Dict[str, Dict[str, Any]] = {}
         self._arrays: Dict[str, np.ndarray] = {}
@@ -279,6 +280,17 @@ class PSServer:
             self._sock.close()
         except OSError:
             pass
+        # reap connection threads (daemon threads that own self._lock
+        # must not outlive stop()) and the accept loop, bounded
+        with self._lock:
+            serve_threads = list(self._serve_threads)
+            self._serve_threads.clear()
+        me = threading.current_thread()
+        for t in serve_threads:
+            if t is not me:
+                t.join(timeout=2.0)
+        if self._thread is not None and self._thread is not me:
+            self._thread.join(timeout=2.0)
 
     def _accept_loop(self) -> None:
         while not self._done.is_set():
@@ -289,8 +301,13 @@ class PSServer:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            with self._lock:
+                self._serve_threads = [x for x in self._serve_threads
+                                       if x.is_alive()]
+                self._serve_threads.append(t)
+            t.start()
 
     def _serve(self, conn: socket.socket) -> None:
         """One worker connection: framed request/reply until EOF."""

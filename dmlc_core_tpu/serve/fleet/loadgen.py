@@ -187,16 +187,27 @@ def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
     merged: Dict[str, Any] = {"count": 0, "ok": 0, "dropped": 0,
                               "wrong": 0, "by_version": {}}
     lats: List[float] = []
-    for cfg, proc in children:
-        rc = proc.wait(timeout=duration_s + 120)
-        CHECK(rc == 0, f"loadgen worker exited rc={rc}")
-        with open(cfg["out"]) as f:
-            rep = json.load(f)
-        for k in ("count", "ok", "dropped", "wrong"):
-            merged[k] += rep[k]
-        for v, n in rep["by_version"].items():
-            merged["by_version"][v] = merged["by_version"].get(v, 0) + n
-        lats.extend(rep["lats_ms"])
+    try:
+        for cfg, proc in children:
+            rc = proc.wait(timeout=duration_s + 120)
+            CHECK(rc == 0, f"loadgen worker exited rc={rc}")
+            with open(cfg["out"]) as f:
+                rep = json.load(f)
+            for k in ("count", "ok", "dropped", "wrong"):
+                merged[k] += rep[k]
+            for v, n in rep["by_version"].items():
+                merged["by_version"][v] = merged["by_version"].get(v, 0) + n
+            lats.extend(rep["lats_ms"])
+    finally:
+        # a mid-loop CHECK failure must not strand the remaining workers
+        for _cfg, proc in children:
+            if proc.returncode is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
     wall = time.monotonic() - t0
     merged["wall_s"] = round(wall, 3)
     merged["throughput_rps"] = round(merged["ok"] / max(wall, 1e-9), 2)

@@ -41,7 +41,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -87,6 +87,8 @@ class HttpServer:
         self.port = self._sock.getsockname()[1]
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._conn_lock = threading.Lock()
+        self._conn_threads: List[threading.Thread] = []
 
     @property
     def url(self) -> str:
@@ -106,8 +108,8 @@ class HttpServer:
         return self
 
     def close(self) -> None:
-        """Stop accepting and join the accept loop.  Connection threads
-        already past accept finish their one request and exit."""
+        """Stop accepting, join the accept loop, then reap connection
+        threads (each finishes its one request) with a bounded wait."""
         self._done.set()
         try:
             self._sock.close()
@@ -115,6 +117,11 @@ class HttpServer:
             pass
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        with self._conn_lock:
+            conn_threads = list(self._conn_threads)
+            self._conn_threads.clear()
+        for t in conn_threads:
+            t.join(timeout=2.0)
 
     def __enter__(self) -> "HttpServer":
         return self.start()
@@ -142,8 +149,13 @@ class HttpServer:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            with self._conn_lock:
+                self._conn_threads = [x for x in self._conn_threads
+                                      if x.is_alive()]
+                self._conn_threads.append(t)
+            t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         t0 = get_time()

@@ -106,6 +106,7 @@ class RabitTracker:
         self._done = threading.Event()
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        self._serve_threads: List[threading.Thread] = []
         # Liveness bookkeeping (reference holds worker connections open for
         # the whole job, so a dying worker is observable; same here for
         # workers that handshake with persistent=True via WorkerSession).
@@ -147,7 +148,13 @@ class RabitTracker:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            with self._lock:
+                self._serve_threads = [x for x in self._serve_threads
+                                       if x.is_alive()]
+                self._serve_threads.append(t)
+            t.start()
 
     def _serve(self, conn: socket.socket) -> None:
         """Serve one worker connection until it closes.
@@ -431,6 +438,18 @@ class RabitTracker:
             self._sock.close()
         except OSError:
             pass
+        # reap the connection-serving threads (their sockets just
+        # closed, so each exits promptly) and the accept loop itself —
+        # a daemon thread that owns self._lock must not outlive stop()
+        with self._lock:
+            serve_threads = list(self._serve_threads)
+            self._serve_threads.clear()
+        me = threading.current_thread()
+        for t in serve_threads:
+            if t is not me:
+                t.join(timeout=2.0)
+        if self._thread is not None and self._thread is not me:
+            self._thread.join(timeout=2.0)
 
     # -- client side (worker) -------------------------------------------
     @staticmethod

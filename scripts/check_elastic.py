@@ -23,7 +23,10 @@ Every process (parent + workers) runs under ``DMLC_LOCKCHECK=1`` +
 ``DMLC_RACECHECK=1`` and verifies zero lock-order cycles; the parent
 additionally asserts zero happens-before races and archives the
 racecheck report to ``ELASTIC_RACECHECK_OUT`` (default
-``/tmp/elastic_racecheck.json``).  Recovery metrics
+``/tmp/elastic_racecheck.json``).  ``DMLC_LEAKCHECK=1`` additionally
+gates GREEN on zero live resource leaks at exit, archived to
+``ELASTIC_LEAKCHECK_OUT`` (default ``/tmp/elastic_leakcheck.json``).
+Recovery metrics
 (``dmlc_worker_deaths_total{outcome}``, ``dmlc_elastic_reshards_total``,
 ``dmlc_recovery_floor_round``) are asserted on the tracker registry.
 
@@ -173,11 +176,12 @@ def main() -> None:
 
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
+    os.environ.setdefault("DMLC_LEAKCHECK", "1")
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
-    from dmlc_core_tpu.base import lockcheck, racecheck
+    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
     from dmlc_core_tpu.base.metrics import default_registry
     from dmlc_core_tpu.parallel.recovery import ElasticTracker
 
@@ -284,6 +288,12 @@ def main() -> None:
     racecheck.check()
     print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
           f"(parent; report at {rc_out})")
+    lk_out = os.environ.get("ELASTIC_LEAKCHECK_OUT",
+                            "/tmp/elastic_leakcheck.json")
+    leakcheck.write_report(lk_out)
+    leakcheck.check()
+    print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
+          f"(parent; report at {lk_out})")
     print("ELASTIC CHAOS DRILL GREEN")
 
 

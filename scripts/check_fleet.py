@@ -22,7 +22,9 @@ The JSON report (counts, latencies, per-phase verdicts) is archived to
 Parent runs under ``DMLC_LOCKCHECK=1`` + ``DMLC_RACECHECK=1`` and
 verifies zero lock-order cycles AND zero happens-before races across
 the whole drill; the racecheck report is archived to
-``FLEET_RACECHECK_OUT`` (default ``/tmp/fleet_racecheck.json``).
+``FLEET_RACECHECK_OUT`` (default ``/tmp/fleet_racecheck.json``), and
+``DMLC_LEAKCHECK=1`` gates GREEN on zero live resource leaks at exit
+(``FLEET_LEAKCHECK_OUT``, default ``/tmp/fleet_leakcheck.json``).
 Exit 0 = drill green.  Usage:
     python scripts/check_fleet.py
 """
@@ -61,13 +63,14 @@ def _wait(pred, timeout_s, label):
 def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
+    os.environ.setdefault("DMLC_LEAKCHECK", "1")
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
     import numpy as np
 
-    from dmlc_core_tpu.base import lockcheck, racecheck
+    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
     from dmlc_core_tpu.models import HistGBT
     from dmlc_core_tpu.serve import checkpoint_model
     from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
@@ -227,6 +230,12 @@ def main() -> None:
     racecheck.check()
     print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
           f"(parent; report at {rc_out})")
+    lk_out = os.environ.get("FLEET_LEAKCHECK_OUT",
+                            "/tmp/fleet_leakcheck.json")
+    leakcheck.write_report(lk_out)
+    leakcheck.check()
+    print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
+          f"(parent; report at {lk_out})")
     print("FLEET CHAOS DRILL GREEN")
 
 

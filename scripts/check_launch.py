@@ -23,7 +23,9 @@ grammar:
 
 The whole drill runs under ``DMLC_LOCKCHECK=1`` + ``DMLC_RACECHECK=1``
 with zero findings required; the racecheck report is archived to
-``LAUNCH_RACECHECK_OUT`` (default ``/tmp/launch_racecheck.json``).
+``LAUNCH_RACECHECK_OUT`` (default ``/tmp/launch_racecheck.json``), and
+``DMLC_LEAKCHECK=1`` gates GREEN on zero live resource leaks at exit
+(``LAUNCH_LEAKCHECK_OUT``, default ``/tmp/launch_leakcheck.json``).
 Exit 0 = drill green.  Usage:
     python scripts/check_launch.py            # run the drill
     python scripts/check_launch.py --worker   # (internal worker entry)
@@ -155,13 +157,14 @@ def main() -> None:
 
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
+    os.environ.setdefault("DMLC_LEAKCHECK", "1")
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
     import numpy as np
 
-    from dmlc_core_tpu.base import lockcheck, racecheck
+    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
     from dmlc_core_tpu.launch import launch_metrics
 
     tmp = tempfile.mkdtemp(prefix="dmlc_launch")
@@ -276,6 +279,12 @@ def main() -> None:
     racecheck.check()
     print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
           f"(parent; report at {rc_out})")
+    lk_out = os.environ.get("LAUNCH_LEAKCHECK_OUT",
+                            "/tmp/launch_leakcheck.json")
+    leakcheck.write_report(lk_out)
+    leakcheck.check()
+    print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
+          f"(parent; report at {lk_out})")
     print("LAUNCH DRILL GREEN")
 
 

@@ -22,7 +22,9 @@ KVStore, twice:
 Every process runs under ``DMLC_LOCKCHECK=1`` + ``DMLC_RACECHECK=1``
 and verifies zero lock-order cycles; the parent additionally asserts
 zero happens-before races and archives the report to
-``PS_RACECHECK_OUT`` (default ``/tmp/ps_racecheck.json``).
+``PS_RACECHECK_OUT`` (default ``/tmp/ps_racecheck.json``), and — under
+``DMLC_LEAKCHECK=1`` — zero live resource leaks at exit, archived to
+``PS_LEAKCHECK_OUT`` (default ``/tmp/ps_leakcheck.json``).
 
 Exit 0 = both phases green.  Usage:
     python scripts/check_ps.py             # run the drill
@@ -244,7 +246,8 @@ def main() -> None:
 
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
-    from dmlc_core_tpu.base import lockcheck, racecheck
+    os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
 
     tmp = tempfile.mkdtemp(prefix="dmlc_ps_drill")
     staleness_bound = int(os.environ.get("DMLC_PS_STALENESS", 4))
@@ -284,6 +287,11 @@ def main() -> None:
     racecheck.check()
     print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
           f"(parent; report at {rc_out})")
+    lk_out = os.environ.get("PS_LEAKCHECK_OUT", "/tmp/ps_leakcheck.json")
+    leakcheck.write_report(lk_out)
+    leakcheck.check()
+    print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
+          f"(parent; report at {lk_out})")
     print("PS CHAOS DRILL GREEN")
 
 

@@ -20,10 +20,12 @@ rm -rf build/lib build/bdist.* ./*.egg-info
 
 echo "== dmlcheck =="
 # project-aware static analysis (lock discipline, jit purity, knob /
-# metric registries, style) over one AST parse per file; runs in BOTH
-# lanes (quick included), budgeted <= 10s over the whole repo, and the
-# JSON report is archived like bench metrics.  doc/static_analysis.md
-# documents passes, suppressions and the baseline workflow.
+# metric registries, resource/thread lifecycles, collective
+# discipline, wire schemas, style) over one AST parse per file; runs
+# in BOTH lanes (quick included), budgeted <= 10s over the whole repo,
+# and the JSON report is archived like bench metrics.
+# doc/static_analysis.md documents passes, suppressions and the
+# baseline workflow.
 DMLCHECK_OUT="${DMLCHECK_OUT:-/tmp/dmlcheck.json}"
 t0=$SECONDS
 python scripts/dmlcheck.py --json "$DMLCHECK_OUT"
@@ -123,11 +125,13 @@ echo "== elastic recovery chaos drill (die / rejoin / catch-up + evict) =="
 # exactly (recovery floor + deterministic fold); the elastic-evict path
 # re-shards onto the survivors and must converge within 1% eval loss.
 # Every process runs under DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1 with
-# zero order cycles and zero happens-before races; the racecheck JSON
-# is archived like the drill report (doc/robustness.md "Distributed
-# recovery").
+# zero order cycles and zero happens-before races, and DMLC_LEAKCHECK=1
+# gates GREEN on zero live resource leaks at exit; the racecheck and
+# leakcheck JSON are archived like the drill report (doc/robustness.md
+# "Distributed recovery").
 env JAX_PLATFORMS=cpu \
     ELASTIC_RACECHECK_OUT="${ELASTIC_RACECHECK_OUT:-/tmp/elastic_racecheck.json}" \
+    ELASTIC_LEAKCHECK_OUT="${ELASTIC_LEAKCHECK_OUT:-/tmp/elastic_leakcheck.json}" \
     python scripts/check_elastic.py
 
 echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
@@ -138,11 +142,13 @@ echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
 # rollout under load must keep per-replica versions monotone and land
 # the whole fleet on v2 — still zero dropped / zero wrong.  The JSON
 # report is archived; parent runs under DMLC_LOCKCHECK=1 +
-# DMLC_RACECHECK=1 with zero order cycles and zero happens-before
-# races, and the racecheck JSON is archived alongside
+# DMLC_RACECHECK=1 + DMLC_LEAKCHECK=1 with zero order cycles, zero
+# happens-before races and zero live resource leaks at exit; the
+# racecheck and leakcheck JSON are archived alongside
 # (doc/serving.md "Fleet serving").
 env JAX_PLATFORMS=cpu \
     FLEET_RACECHECK_OUT="${FLEET_RACECHECK_OUT:-/tmp/fleet_racecheck.json}" \
+    FLEET_LEAKCHECK_OUT="${FLEET_LEAKCHECK_OUT:-/tmp/fleet_leakcheck.json}" \
     python scripts/check_fleet.py
 
 echo "== parameter-server chaos drill (kill server / respawn / restore) =="
@@ -155,9 +161,11 @@ echo "== parameter-server chaos drill (kill server / respawn / restore) =="
 # within tolerance of the uninterrupted baseline and SSP staleness
 # must stay within DMLC_PS_STALENESS.  All processes run under
 # DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1 with zero order cycles and zero
-# happens-before races (doc/distributed.md "Parameter server").
+# happens-before races, plus DMLC_LEAKCHECK=1 zero-leak gating in the
+# parent (doc/distributed.md "Parameter server").
 env JAX_PLATFORMS=cpu \
     PS_RACECHECK_OUT="${PS_RACECHECK_OUT:-/tmp/ps_racecheck.json}" \
+    PS_LEAKCHECK_OUT="${PS_LEAKCHECK_OUT:-/tmp/ps_leakcheck.json}" \
     python scripts/check_ps.py
 
 echo "== multi-host launch drill (fake cluster / host death / respawn) =="
@@ -170,10 +178,11 @@ echo "== multi-host launch drill (fake cluster / host death / respawn) =="
 # serving fleet 2 -> 4 replicas across fake hosts with zero dropped
 # loadgen requests.  Everything runs under DMLC_LOCKCHECK=1 +
 # DMLC_RACECHECK=1 with zero order cycles and zero happens-before
-# races; racecheck JSON archived (doc/distributed.md "Multi-host
-# launch").
+# races, plus DMLC_LEAKCHECK=1 zero-leak gating; racecheck and
+# leakcheck JSON archived (doc/distributed.md "Multi-host launch").
 env JAX_PLATFORMS=cpu \
     LAUNCH_RACECHECK_OUT="${LAUNCH_RACECHECK_OUT:-/tmp/launch_racecheck.json}" \
+    LAUNCH_LEAKCHECK_OUT="${LAUNCH_LEAKCHECK_OUT:-/tmp/launch_leakcheck.json}" \
     python scripts/check_launch.py
 
 if [[ "${1:-}" != "quick" ]]; then

@@ -18,7 +18,19 @@ grammar and baseline workflow):
 * ``knob-registry`` / ``knob-doc`` — every ``DMLC_*`` literal declared
   in base/knobs.py, every declaration documented under doc/;
 * ``metric-registry`` / ``metric-doc`` — unique (kind, label-set) per
-  ``dmlc_*`` metric name, all documented in doc/observability.md.
+  ``dmlc_*`` metric name, all documented in doc/observability.md;
+* ``resource-leak`` — sockets / subprocesses / tempfiles acquired
+  without with/close/ownership-transfer, or stored on a class with no
+  teardown method;
+* ``thread-lifecycle`` — non-daemon threads never joined, and daemon
+  threads whose target takes class locks (they can die mid-critical-
+  section at interpreter exit);
+* ``collective-discipline`` — collective calls (allreduce / barrier /
+  broadcast / commit) under rank-conditional branches, a deadlock by
+  construction;
+* ``wire-schema`` — every literal ``{"cmd": ...}`` message checked
+  against the central registry in base/wire_schemas.py, plus the
+  ``DMLC_*`` env-injection ABI for launch/ and tracker/.
 
 Usage:
     python scripts/dmlcheck.py                     # full run, baseline applied

@@ -659,13 +659,323 @@ def test_atomicity_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# resource-leak (ISSUE 15): acquisition shapes for OS handles
+# ---------------------------------------------------------------------------
+
+_RESOURCE_BAD = """
+    import socket
+    import subprocess
+    import tempfile
+
+    def probe(host):
+        s = socket.socket()
+        s.connect((host, 80))
+        data = s.recv(1)        # s never closed/transferred
+        return data
+
+    def fire():
+        subprocess.Popen(["sleep", "1"])    # bare: only handle discarded
+
+    def scratch(blob):
+        fd, path = tempfile.mkstemp()
+        record(path, blob)      # fd leaks (path escaped, fd did not)
+
+    class Holder:
+        def start(self):
+            self._sock = socket.create_connection(("h", 80))
+        # no close/stop/shutdown/__del__ anywhere in the class
+"""
+
+_RESOURCE_GOOD = """
+    import socket
+    import subprocess
+    import os
+    import tempfile
+
+    def probe(host):
+        with socket.create_connection((host, 80)) as s:
+            return s.recv(1)
+
+    def connect(host):
+        s = socket.socket()
+        s.connect((host, 80))
+        return s                # ownership transferred to the caller
+
+    def spawn(cmd, registry):
+        p = subprocess.Popen(cmd)
+        registry.track(p)       # handed to an owner
+        return p.pid
+
+    def scratch(blob):
+        fd, path = tempfile.mkstemp()
+        os.close(fd)
+        return path
+
+    class Holder:
+        def start(self):
+            self._sock = socket.create_connection(("h", 80))
+
+        def close(self):        # registered teardown owns self._sock
+            self._sock.close()
+"""
+
+
+def test_resource_leak_flags_unreleased_shapes(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _RESOURCE_BAD}),
+                  rules=["resource-leak"])
+    keys = sorted(f.key for f in _findings(ctx, "resource-leak"))
+    assert keys == ["Holder.start:self._sock", "fire:bare-subprocess",
+                    "probe:s", "scratch:fd"]
+    assert any("declares no teardown" in f.message for f in ctx.findings)
+
+
+def test_resource_leak_clean_lifecycle_shapes(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _RESOURCE_GOOD}),
+                  rules=["resource-leak"])
+    assert _findings(ctx) == []
+
+
+def test_resource_leak_suppression(tmp_path):
+    src = _RESOURCE_BAD.replace(
+        'subprocess.Popen(["sleep", "1"])    # bare: only handle discarded',
+        'subprocess.Popen(["sleep", "1"])  # dmlcheck: off:resource-leak')
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["resource-leak"])
+    assert len(_findings(ctx, "resource-leak")) == 3
+    assert ctx.suppressed_count == 1
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle (ISSUE 15): joinable-and-joined, or daemon-and-lockfree
+# ---------------------------------------------------------------------------
+
+_THREAD_BAD = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()     # no method of Server ever joins it
+
+        def _loop(self):
+            pass
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def kick(self):
+            t = threading.Thread(target=self._work, daemon=True)
+            t.start()           # daemon, but _work takes self._lock
+
+        def _work(self):
+            with self._lock:
+                pass
+
+    def fire_and_forget(fn):
+        threading.Thread(target=fn).start()     # never joinable
+"""
+
+_THREAD_GOOD = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def close(self):
+            self._t.join(timeout=2.0)   # bounded join in teardown
+
+        def _loop(self):
+            pass
+
+    class Beacon:
+        def kick(self):
+            t = threading.Thread(target=self._ping, daemon=True)
+            t.start()           # daemon AND lock-free: allowed
+
+        def _ping(self):
+            pass
+
+    def batch(fns):
+        ts = [threading.Thread(target=f) for f in fns]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()            # comp joined via the loop var
+        return ts
+"""
+
+
+def test_thread_lifecycle_flags_unjoined_and_daemon_lockers(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _THREAD_BAD}),
+                  rules=["thread-lifecycle"])
+    keys = sorted(f.key for f in _findings(ctx, "thread-lifecycle"))
+    assert keys == ["Pool.kick:t", "Server.start:self._t",
+                    "fire_and_forget:chain-thread"]
+    assert any("acquires the class's locks" in f.message
+               for f in ctx.findings)
+
+
+def test_thread_lifecycle_clean_join_daemon_and_comp_shapes(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _THREAD_GOOD}),
+                  rules=["thread-lifecycle"])
+    assert _findings(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# collective-discipline (ISSUE 15): rank-invariant collective order
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_BAD = """
+    def save(coll, rank, model):
+        if rank == 0:
+            write(model)
+            coll.barrier("ckpt")    # ranks != 0 never arrive
+"""
+
+_COLLECTIVE_GOOD = """
+    def save(coll, rank, model):
+        if rank == 0:
+            write(model)
+        coll.barrier("ckpt")        # every rank arrives
+
+    def broadcast(coll, rank, v):
+        # transport implementations branch on rank by definition
+        if rank == 0:
+            coll.bcast(v)
+        return coll.recv()
+
+    def report(rank, log):
+        if rank == 0:
+            log.commit_msg()        # commit_msg is not 'commit'
+"""
+
+
+def test_collective_discipline_flags_rank_conditional_barrier(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _COLLECTIVE_BAD}),
+                  rules=["collective-discipline"])
+    got = _findings(ctx, "collective-discipline")
+    assert len(got) == 1 and got[0].key == "save:barrier"
+    assert "rank-conditional" in got[0].message
+
+
+def test_collective_discipline_clean_hoisted_and_transport_exempt(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _COLLECTIVE_GOOD}),
+                  rules=["collective-discipline"])
+    assert _findings(ctx) == []
+
+
+def test_collective_discipline_suppression_with_rationale(tmp_path):
+    src = _COLLECTIVE_BAD.replace(
+        'coll.barrier("ckpt")    # ranks != 0 never arrive',
+        'coll.barrier("ckpt")  # dmlcheck: off:collective-discipline')
+    ctx = analyze(_mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": src}),
+                  rules=["collective-discipline"])
+    assert _findings(ctx) == [] and ctx.suppressed_count == 1
+
+
+# ---------------------------------------------------------------------------
+# wire-schema (ISSUE 15): the registry is the wire contract
+# ---------------------------------------------------------------------------
+
+_WIRE_REGISTRY = """
+    COMMANDS = {
+        "ping": frozenset({"cmd", "token"}),
+        "bye": frozenset({"cmd"}),
+    }
+    WIRE_FRAMING = frozenset({"arrays"})
+    ENV_ABI = frozenset({"DMLC_TASK_ID"})
+"""
+
+_WIRE_BAD = """
+    def send(conn, tok, c):
+        conn.request({"cmd": "ping", "token": tok, "extra": 1})
+        conn.request({"cmd": "nope"})
+        conn.request({"cmd": c, "mystery": tok})
+"""
+
+_WIRE_GOOD = """
+    def send(conn, tok, c, blob):
+        conn.request({"cmd": "ping", "token": tok})
+        conn.request({"cmd": "bye", "arrays": blob})    # framing key
+        conn.request({"cmd": c, "token": tok})          # dynamic, in vocab
+        route({"command": "free-form"})  # no "cmd" key: not a wire dict
+"""
+
+
+def _wire_repo(tmp_path, files, registry=_WIRE_REGISTRY):
+    files = dict(files)
+    if registry is not None:
+        files["dmlc_core_tpu/base/wire_schemas.py"] = registry
+    return _mini_repo(tmp_path, files)
+
+
+def test_wire_schema_flags_unknown_cmd_key_and_dynamic(tmp_path):
+    ctx = analyze(_wire_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _WIRE_BAD}),
+                  rules=["wire-schema"])
+    keys = sorted(f.key for f in _findings(ctx, "wire-schema"))
+    assert keys == ["cmd:nope", "dynamic.mystery", "ping.extra"]
+
+
+def test_wire_schema_clean_declared_framing_and_dynamic(tmp_path):
+    ctx = analyze(_wire_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _WIRE_GOOD}),
+                  rules=["wire-schema"])
+    assert _findings(ctx) == []
+
+
+def test_wire_schema_missing_registry_is_loud(tmp_path):
+    ctx = analyze(_wire_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _WIRE_GOOD},
+                             registry=None),
+                  rules=["wire-schema"])
+    got = _findings(ctx, "wire-schema")
+    assert got and all(f.key == "registry-missing" for f in got)
+
+
+_ENV_INJECT = """
+    def inject(env):
+        env["DMLC_TASK_ID"] = "0"           # declared in ENV_ABI
+        env["DMLC_FIXTURE_ROGUE"] = "1"
+        env.setdefault("DMLC_FIXTURE_LAZY", "2")
+"""
+
+
+def test_wire_schema_env_abi_only_in_launch_and_tracker(tmp_path):
+    ctx = analyze(_wire_repo(tmp_path, {
+        "dmlc_core_tpu/launch/envs.py": _ENV_INJECT,
+        "dmlc_core_tpu/mod.py": _ENV_INJECT,     # out of ABI scope
+    }), rules=["wire-schema"])
+    keys = sorted(f.key for f in _findings(ctx, "wire-schema"))
+    assert keys == ["env:DMLC_FIXTURE_LAZY", "env:DMLC_FIXTURE_ROGUE"]
+    assert all(f.path.endswith("launch/envs.py") for f in ctx.findings)
+
+
+# ---------------------------------------------------------------------------
 # CLI satellites: --explain, stale-baseline FAIL, per-pass timings
 # ---------------------------------------------------------------------------
 
 def test_rule_help_has_doc_and_example_pair():
     from dmlc_core_tpu.analysis import rule_help
 
-    for rule in ("lock-blocking", "atomicity"):
+    for rule in ("lock-blocking", "atomicity", "resource-leak",
+                 "thread-lifecycle", "collective-discipline",
+                 "wire-schema"):
         info = rule_help(rule)
         assert info["rule"] == rule
         assert info["doc"] and info["flagged"] and info["clean"]
@@ -712,3 +1022,4 @@ def test_cli_timings_reports_new_passes(tmp_path):
     assert r.returncode == 0
     assert "per-pass timings" in r.stderr
     assert "blocking" in r.stderr and "atomicity" in r.stderr
+    assert "resources" in r.stderr and "protocol" in r.stderr

@@ -1,0 +1,218 @@
+"""leakcheck (dynamic resource-leak tracer) contracts.
+
+The static passes prove acquisition shape; these tests prove the
+dynamic half: every resource kind is traced with its repo creation
+stack, a genuinely leaked resource is reported as such, a clean
+shutdown is silent, and with the env gate off nothing is patched at
+all (creation paths run at original speed).
+
+This file lives under tests/ on purpose: leakcheck only records
+creations whose stack passes through the repo, and the test file IS
+the repo frame.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+
+import pytest
+
+from dmlc_core_tpu.base import leakcheck
+
+
+@pytest.fixture
+def traced():
+    installed_before = leakcheck.installed()
+    if not installed_before:
+        leakcheck.install()
+    leakcheck.reset()
+    yield
+    leakcheck.reset()
+    if not installed_before:
+        leakcheck.uninstall()
+
+
+def _leaks(kind=None):
+    got = leakcheck.leaks()
+    return [x for x in got if kind is None or x["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# the seeded leak: a socket left open inside a worker thread
+# ---------------------------------------------------------------------------
+
+def test_seeded_socket_leak_reported_with_creation_stack(traced):
+    holder = {}
+
+    def worker():
+        holder["sock"] = socket.socket()        # opened, never closed
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    got = _leaks("socket")
+    assert len(got) == 1, got
+    # the creation stack must name THIS file and the worker function —
+    # that's what makes the report actionable
+    assert "tests/test_leakcheck.py" in got[0]["site"]
+    assert "(worker)" in got[0]["site"]
+    with pytest.raises(leakcheck.LeakError, match="socket"):
+        leakcheck.check()
+    holder["sock"].close()
+    assert _leaks("socket") == []
+    leakcheck.check()                           # now silent
+
+
+# ---------------------------------------------------------------------------
+# every resource kind traced, and released resources drop off lazily
+# ---------------------------------------------------------------------------
+
+def test_socket_traced_and_close_clears(traced):
+    s = socket.socket()
+    assert len(_leaks("socket")) == 1
+    s.close()
+    assert _leaks("socket") == []
+
+
+def test_thread_traced_while_alive_only(traced):
+    gate = threading.Event()
+    t = threading.Thread(target=gate.wait)
+    t.start()
+    try:
+        assert any("thread" == x["kind"] for x in _leaks())
+    finally:
+        gate.set()
+        t.join()
+    assert _leaks("thread") == []
+
+
+def test_subprocess_zombie_stays_leaked_until_waited(traced):
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    # wait for the child to EXIT without reaping it (WNOWAIT): zombie
+    os.waitid(os.P_PID, p.pid, os.WEXITED | os.WNOWAIT)
+    assert len(_leaks("subprocess")) == 1, \
+        "an exited-but-unwaited child must still count as leaked"
+    p.wait(timeout=10)
+    assert _leaks("subprocess") == []
+
+
+def test_named_tempfile_traced(traced):
+    f = tempfile.NamedTemporaryFile()
+    assert len(_leaks("tempfile")) == 1
+    f.close()
+    assert _leaks("tempfile") == []
+
+
+def test_mkstemp_fd_traced_and_fd_recycling_not_confused(traced):
+    fd, path = tempfile.mkstemp()
+    try:
+        assert len(_leaks("tempfile")) == 1
+        assert f"fd={fd}" in _leaks("tempfile")[0]["detail"]
+    finally:
+        os.close(fd)
+        os.unlink(path)
+    # recycle the fd number onto a different inode: must NOT re-live
+    fd2 = os.open(os.devnull, os.O_RDONLY)
+    try:
+        assert _leaks("tempfile") == []
+    finally:
+        os.close(fd2)
+
+
+# ---------------------------------------------------------------------------
+# clean shutdown is silent; reports archive the counts
+# ---------------------------------------------------------------------------
+
+def test_clean_shutdown_is_silent(traced):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait(timeout=10)
+    assert leakcheck.leaks() == []
+    leakcheck.check()                           # must not raise
+
+
+def test_write_report_counts_created_and_leaks(traced, tmp_path):
+    import json
+
+    s = socket.socket()
+    out = str(tmp_path / "leak" / "report.json")
+    rep = leakcheck.write_report(out)
+    assert rep["enabled"] and rep["created"]["socket"] == 1
+    assert len(rep["leaks"]) == 1
+    with open(out) as f:
+        assert json.load(f) == rep
+    s.close()
+    assert leakcheck.write_report(out)["leaks"] == []
+
+
+def test_third_party_creations_ignored(traced):
+    """A creation whose stack never passes through the repo is not ours
+    to police — create the socket on a thread whose entire call stack
+    is non-repo code (the thread bootstrap plus an exec'd module)."""
+    src = ("import socket\n"
+           "def make(holder):\n"
+           "    holder['s'] = socket.socket()\n")
+    code = compile(src, "/no/such/place/elsewhere.py", "exec")
+    ns = {}
+    exec(code, ns)
+    holder = {}
+    t = threading.Thread(target=ns["make"], args=(holder,))
+    t.start()
+    t.join()
+    try:
+        assert _leaks("socket") == []
+    finally:
+        holder["s"].close()
+
+
+# ---------------------------------------------------------------------------
+# the env gate: off means NOTHING is patched
+# ---------------------------------------------------------------------------
+
+def test_env_gate():
+    for v, want in (("1", True), ("true", True), ("raise", True),
+                    ("0", False), ("off", False)):
+        os.environ["DMLC_LEAKCHECK"] = v
+        assert leakcheck.env_enabled() is want
+    os.environ.pop("DMLC_LEAKCHECK", None)
+
+
+def test_disabled_means_unpatched():
+    """DMLC_LEAKCHECK=0 must be zero-cost: the stdlib creation points
+    are the originals, not wrappers."""
+    assert not leakcheck.installed()
+    assert socket.socket.__name__ == "socket"
+    assert "leakcheck" not in getattr(threading.Thread.start,
+                                      "__module__", "")
+    assert tempfile.mkstemp is not leakcheck._traced_mkstemp
+    s = socket.socket()
+    s.close()
+    assert leakcheck.leaks() == []              # nothing recorded
+
+
+def test_install_uninstall_restores_originals():
+    before = (socket.socket, threading.Thread.start,
+              subprocess.Popen.__init__, tempfile.NamedTemporaryFile,
+              tempfile.mkstemp)
+    leakcheck.install()
+    try:
+        assert leakcheck.installed()
+        assert socket.socket is leakcheck._TracedSocket
+        leakcheck.install()                     # idempotent
+    finally:
+        leakcheck.uninstall()
+        leakcheck.uninstall()                   # idempotent
+        leakcheck.reset()
+    after = (socket.socket, threading.Thread.start,
+             subprocess.Popen.__init__, tempfile.NamedTemporaryFile,
+             tempfile.mkstemp)
+    assert after == before

@@ -519,8 +519,13 @@ def test_chaos_soak_train_and_serve():
     The happens-before race detector (``base/racecheck``) rides the
     same workload: registry hot-swap state, batcher queue handoffs and
     client threads all cross under faults, and the run must finish with
-    ZERO unordered shared-attribute access pairs."""
-    from dmlc_core_tpu.base import lockcheck, racecheck
+    ZERO unordered shared-attribute access pairs.
+
+    The resource-leak tracer (``base/leakcheck``) rides it too: every
+    socket/thread/subprocess/tempfile the soak creates must be dead by
+    teardown (the report is archived to ``SOAK_LEAKCHECK_OUT``,
+    default ``/tmp/soak_leakcheck.json``)."""
+    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
     from dmlc_core_tpu.models.histgbt import HistGBT
     from dmlc_core_tpu.serve import ModelRegistry, ResilientClient, \
         ServeFrontend
@@ -531,6 +536,10 @@ def test_chaos_soak_train_and_serve():
     rc_installed = not racecheck.installed()
     if rc_installed:
         racecheck.install()
+    lc_installed = not leakcheck.installed()
+    if lc_installed:
+        leakcheck.install()
+    leakcheck.reset()
 
     rng = np.random.default_rng(0)
     X = rng.standard_normal((512, 8)).astype(np.float32)
@@ -578,6 +587,12 @@ def test_chaos_soak_train_and_serve():
             faults = fi.fired_total()
 
     race_list = racecheck.races()
+    leakcheck.write_report(os.environ.get("SOAK_LEAKCHECK_OUT",
+                                          "/tmp/soak_leakcheck.json"))
+    leak_list = leakcheck.leaks()
+    leakcheck.reset()
+    if lc_installed:
+        leakcheck.uninstall()
     if rc_installed:
         racecheck.uninstall()
     if we_installed:
@@ -586,6 +601,8 @@ def test_chaos_soak_train_and_serve():
         f"lock-order cycles under chaos: {lockcheck.violations()}")
     assert race_list == [], (
         f"happens-before races under chaos: {race_list}")
+    assert leak_list == [], (
+        f"live resource leaks under chaos: {leak_list}")
     assert wrong == [], f"wrong answers under chaos: {wrong}"
     assert faults > 0, "chaos soak injected nothing"
     assert answered[0] > 0, "every request shed — retry layer is dead"
