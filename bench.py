@@ -141,6 +141,17 @@ def _metrics_out_path():
     return os.environ.get("BENCH_METRICS_OUT")
 
 
+def _slo_path():
+    """--slo PATH / --slo=PATH / DMLC_SLO_SPEC env — committed SLO spec
+    to score the final record against (None = skip)."""
+    for i, a in enumerate(sys.argv):
+        if a == "--slo" and i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+        if a.startswith("--slo="):
+            return a.split("=", 1)[1]
+    return os.environ.get("DMLC_SLO_SPEC") or None
+
+
 def _attach_metrics(out):
     """Final-record metrics: archive the full registry snapshot when
     --metrics-out/BENCH_METRICS_OUT names a path, and inline a compact
@@ -181,9 +192,44 @@ def _attach_metrics(out):
             m = snap.get(name)
             summary[field] = (sum(s["value"] for s in m["series"])
                               if m and m["series"] else 0.0)
+        # fleet-wide view: when this process spools (DMLC_METRICS_SPOOL),
+        # say how many processes the merged snapshot covers — a fleet
+        # bench whose children never spooled reads 1, not silence
+        from dmlc_core_tpu.base import metrics_agg
+        sw = metrics_agg.installed_spool()
+        if sw is not None:
+            sw.flush()
+            _, nprocs = metrics_agg.merge_spool(os.path.dirname(sw.path))
+            summary["spool_processes_merged"] = nprocs
         out["metrics_summary"] = summary
     except Exception as e:  # noqa: BLE001
         out["metrics_error"] = f"{type(e).__name__}: {e}"[:200]
+
+
+def _attach_slo(out):
+    """Score the final record against a committed SLO spec (--slo PATH /
+    DMLC_SLO_SPEC).  The snapshot is the fleet-merged spool view when a
+    spool is installed, else this process's registry; the record itself
+    is the evidence dict, so objectives can reference headline fields
+    (``{"evidence": "dropped"}``).  Never fatal — the headline record
+    must survive a scorecard failure."""
+    path = _slo_path()
+    if not path:
+        return
+    try:
+        from dmlc_core_tpu.base import metrics_agg, slo
+        from dmlc_core_tpu.base.metrics import default_registry
+
+        sw = metrics_agg.installed_spool()
+        if sw is not None:
+            sw.flush()
+            snapshot, _ = metrics_agg.merge_spool(os.path.dirname(sw.path))
+        else:
+            snapshot = default_registry().snapshot()
+        out["slo"] = slo.evaluate(slo.SLOSpec.load(path), snapshot,
+                                  evidence=out)
+    except Exception as e:  # noqa: BLE001
+        out["slo_error"] = f"{type(e).__name__}: {e}"[:200]
 
 
 def emit(final=False, **extra):
@@ -230,6 +276,7 @@ def emit(final=False, **extra):
         out["notes"] = EV["notes"]
     if final:
         _attach_metrics(out)
+        _attach_slo(out)
     out.update(extra)
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(out) + "\n")
@@ -628,6 +675,7 @@ def _serve_emit(rec, final=False):
            "provisional": not final, **rec}
     if final:
         _attach_metrics(rec)
+        _attach_slo(rec)
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(rec) + "\n")
         sys.stdout.flush()
@@ -777,6 +825,7 @@ def _fleet_emit(rec, final=False):
            "provisional": not final, **rec}
     if final:
         _attach_metrics(rec)
+        _attach_slo(rec)
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(rec) + "\n")
         sys.stdout.flush()
@@ -921,6 +970,7 @@ def _stream_emit(rec, final=False):
            "provisional": not final, **rec}
     if final:
         _attach_metrics(rec)
+        _attach_slo(rec)
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(rec) + "\n")
         sys.stdout.flush()
@@ -1215,6 +1265,7 @@ def _ps_bench() -> None:
                  "aggregation are real, network hops are loopback",
     }
     _attach_metrics(rec)
+    _attach_slo(rec)
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(rec) + "\n")
         sys.stdout.flush()
@@ -1551,6 +1602,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # observability plane: join the metrics spool when one is configured
+    # (no-op otherwise) so the bench parent's registry merges with any
+    # spawned replicas'/workers' under one DMLC_METRICS_SPOOL directory
+    from dmlc_core_tpu.base.metrics_agg import install_spool
+    install_spool("bench", 0)
     if "--serve" in sys.argv:
         _serve_bench()
     elif "--fleet" in sys.argv:

@@ -133,6 +133,22 @@ declare("DMLC_LEAKCHECK", "0",
 declare("DMLC_INTERLEAVE_SCHEDULES", 200,
         "Schedule budget per model for the interleave model checker "
         "(analysis/interleave).", "observability")
+declare("DMLC_METRICS_SPOOL", "",
+        "Directory for the cross-process metrics spool: each process "
+        "writes its registry snapshot (and trace shard) there for "
+        "fleet-wide merging (base/metrics_agg); empty disables.",
+        "observability")
+declare("DMLC_METRICS_SPOOL_S", 2.0,
+        "Seconds between periodic spool snapshot flushes; <= 0 keeps "
+        "only the at-exit flush.", "observability")
+declare("DMLC_TRACE_CTX", "",
+        "Wire-encoded trace context a launcher injects so child "
+        "processes join the parent's distributed trace "
+        "(base/tracectx); empty starts fresh.", "observability")
+declare("DMLC_SLO_SPEC", "",
+        "Default SLO spec JSON path for scorecard evaluation "
+        "(base/slo; bench.py --slo overrides); empty disables.",
+        "observability")
 
 # -- GBT / compute ----------------------------------------------------------
 declare("DMLC_TPU_ROUNDS_PER_DISPATCH", 25,

@@ -38,6 +38,7 @@ import json
 import os
 import random
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from dmlc_core_tpu.base.timer import get_time
@@ -178,19 +179,31 @@ class Gauge(_MetricBase):
 
     kind = "gauge"
 
+    def __init__(self, name: str, help: str = "",
+                 labels: Sequence[str] = ()) -> None:
+        super().__init__(name, help, labels)
+        # wall-clock write time per series: the cross-process merge
+        # (base/metrics_agg) resolves gauge collisions last-write-wins,
+        # which needs a clock every process shares
+        self._ts: Dict[Tuple[str, ...], float] = {}
+
     def set(self, value: float, **labels: Any) -> None:
         if not _ENABLED:
             return
         key = _label_key(self.label_names, labels)
+        now = time.time()
         with self._lock:
             self._series[key] = float(value)
+            self._ts[key] = now
 
     def inc(self, amount: float = 1.0, **labels: Any) -> None:
         if not _ENABLED:
             return
         key = _label_key(self.label_names, labels)
+        now = time.time()
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + amount
+            self._ts[key] = now
 
     def dec(self, amount: float = 1.0, **labels: Any) -> None:
         self.inc(-amount, **labels)
@@ -200,13 +213,22 @@ class Gauge(_MetricBase):
         with self._lock:
             return self._series.get(key, 0.0)
 
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._ts.clear()
+
     def _export(self) -> Iterator[str]:
         for key, v in sorted(self._series_items()):
             yield f"{self.name}{self._render_labels(key)} {_fmt(v)}"
 
     def _snap(self) -> List[Dict[str, Any]]:
-        return [{"labels": dict(zip(self.label_names, key)), "value": v}
-                for key, v in sorted(self._series_items())]
+        with self._lock:
+            items = sorted(self._series.items())
+            ts = dict(self._ts)
+        return [{"labels": dict(zip(self.label_names, key)), "value": v,
+                 "ts": ts.get(key, 0.0)}
+                for key, v in items]
 
 
 class _HistSeries:
@@ -337,6 +359,10 @@ class Histogram(_MetricBase):
                 "buckets": bkt,
                 "quantiles": {f"p{int(q * 100)}": s.quantile(q)
                               for q in (0.5, 0.9, 0.99)},
+                # raw reservoir rides the snapshot so the cross-process
+                # merge can re-sample quantiles weighted by count
+                # instead of averaging pre-baked percentiles
+                "reservoir": list(s.reservoir),
             })
         return out
 

@@ -59,7 +59,9 @@ COMMANDS: Dict[str, FrozenSet[str]] = {
 }
 
 #: Keys the wire layer itself attaches to every header; always allowed.
-WIRE_FRAMING: FrozenSet[str] = frozenset({"arrays"})
+#: ``trace`` is the distributed trace context (base/tracectx) the
+#: transport stamps on outbound headers when tracing is enabled.
+WIRE_FRAMING: FrozenSet[str] = frozenset({"arrays", "trace"})
 
 #: The launch env ABI: every ``DMLC_*`` variable a launcher/tracker may
 #: *inject* into a worker's environment.  Knob names declared in
@@ -76,6 +78,8 @@ ENV_ABI: FrozenSet[str] = frozenset({
     "DMLC_PS_ROOT_URI",
     "DMLC_PS_ROOT_PORT",
     "DMLC_WORKDIR",
+    "DMLC_METRICS_SPOOL",
+    "DMLC_TRACE_CTX",
 })
 
 

@@ -31,6 +31,7 @@ documented in ``doc/observability.md``.
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 import time
@@ -38,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base import knobs as _knobs
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG
 from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.resilience import RetryPolicy
@@ -129,13 +131,23 @@ class JobSet:
 
     # -- env ABI ---------------------------------------------------------
     def worker_env(self, rank: int, attempt: int = 0) -> Dict[str, str]:
-        """The env OVERLAY rank ``rank`` is spawned with (pure — this is
-        what the golden per-backend env tests snapshot)."""
+        """The env OVERLAY rank ``rank`` is spawned with (pure given a
+        fixed observability env — this is what the golden per-backend
+        env tests snapshot; with no spool/trace configured nothing
+        extra is injected, so the snapshots are exact)."""
         env = dict(self._envs)
         env["DMLC_TASK_ID"] = str(rank)
         env["DMLC_ROLE"] = self._role
         env["DMLC_NUM_ATTEMPT"] = str(attempt)
         env.setdefault("DMLC_NUM_WORKER", str(self._nworker))
+        # observability overlay: children join the launcher's metrics
+        # spool and trace so the whole job aggregates into one artifact
+        spool = os.environ.get("DMLC_METRICS_SPOOL", "")
+        if spool:
+            env.setdefault("DMLC_METRICS_SPOOL", spool)
+        trace = _tracectx.current_header()
+        if trace is not None:
+            env.setdefault(_tracectx.ENV_KEY, trace)
         if self._env_for is not None:
             env.update(self._env_for(rank, attempt) or {})
         return env

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG, Error
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.parallel.ps import wire
@@ -190,6 +191,10 @@ class PSClient:
         self._endpoints: Dict[int, Tuple[str, int]] = {}
         self.nserver = 0
         self.nworker = 0
+        # join the fleet metrics spool (no-op without DMLC_METRICS_SPOOL)
+        from dmlc_core_tpu.base import metrics_agg as _agg
+
+        _agg.install_spool("ps_worker", self.rank)
         self._resolve(resolve_timeout_s)
 
     # -- membership ------------------------------------------------------
@@ -301,14 +306,17 @@ class PSClient:
         ids = np.asarray(ids, np.int64)
         grads = np.asarray(grads)
         hist = ps_metrics()["push"] if _metrics.enabled() else None
-        for sid, pos in parts.items():
-            header = {"cmd": "push", "name": name, "rank": self.rank,
-                      "clock": self.clock}
-            payload = [np.ascontiguousarray(ids[pos]),
-                       np.ascontiguousarray(grads[pos])]
-            self._with_failover(
-                sid, lambda c: c.request(header, payload, wait=wait,
-                                         hist=hist))
+        # the span's context rides the wire framing (ps/wire.send_msg)
+        # to the touched servers — the worker->server trace edge
+        with _tracectx.span("ps.push", array=name):
+            for sid, pos in parts.items():
+                header = {"cmd": "push", "name": name, "rank": self.rank,
+                          "clock": self.clock}
+                payload = [np.ascontiguousarray(ids[pos]),
+                           np.ascontiguousarray(grads[pos])]
+                self._with_failover(
+                    sid, lambda c: c.request(header, payload, wait=wait,
+                                             hist=hist))
 
     def pull(self, name: str, ids: np.ndarray) -> np.ndarray:
         """Pull current values for a sparse id batch.  Requests to all
@@ -322,24 +330,30 @@ class PSClient:
         t0 = get_time()
         results: Dict[int, Any] = {}
         errors: Dict[int, BaseException] = {}
+        trace_hdr: List[Optional[str]] = [None]
 
         def _one(sid: int, pos: np.ndarray) -> None:
             header = {"cmd": "pull", "name": name, "rank": self.rank,
                       "clock": self.clock, "staleness": self.staleness,
                       "timeout_s": self._pull_timeout_s}
             try:
-                results[sid] = self._with_failover(
-                    sid, lambda c: c.request(
-                        header, [np.ascontiguousarray(ids[pos])]))
+                # re-attach the pull span's context: trace state is
+                # thread-local and these are fresh threads
+                with _tracectx.attach(trace_hdr[0]):
+                    results[sid] = self._with_failover(
+                        sid, lambda c: c.request(
+                            header, [np.ascontiguousarray(ids[pos])]))
             except BaseException as e:  # noqa: BLE001 — joined below
                 errors[sid] = e
 
-        threads = [threading.Thread(target=_one, args=(sid, pos))
-                   for sid, pos in parts.items()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        with _tracectx.span("ps.pull", array=name) as _span:
+            trace_hdr[0] = _span.encode() if _span is not None else None
+            threads = [threading.Thread(target=_one, args=(sid, pos))
+                       for sid, pos in parts.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
         if errors:
             raise Error(f"ps pull failed: {errors}")
         out = np.empty((len(ids),) + spec["width"],

@@ -36,6 +36,7 @@ import numpy as np
 
 from dmlc_core_tpu.base import faultinject as _fi
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG
 from dmlc_core_tpu.parallel.ps import wire
 from dmlc_core_tpu.parallel.ps.partition import server_ranges
@@ -213,6 +214,10 @@ class PSServer:
                 self._vclock[r] = 0
         if self._snap_dir:
             self._restore()
+        # join the fleet metrics spool (no-op without DMLC_METRICS_SPOOL)
+        from dmlc_core_tpu.base import metrics_agg as _agg
+
+        _agg.install_spool("ps_server", self.server_id)
 
     # -- snapshot / restore ----------------------------------------------
     def _snapshot_uri(self) -> str:
@@ -316,8 +321,14 @@ class PSServer:
                 f = conn.makefile("rwb")
                 while not self._done.is_set():
                     msg, arrays = wire.recv_msg(f)
-                    reply, out = self._handle(msg, arrays)
-                    wire.send_msg(f, reply, out)
+                    # join the sender's distributed trace for this
+                    # request so the server-side span lands in the same
+                    # timeline (no-op when DMLC_TRACE is off)
+                    with _tracectx.attach(msg.get(_tracectx.WIRE_KEY)):
+                        with _tracectx.span(
+                                f"ps.server.{msg.get('cmd')}"):
+                            reply, out = self._handle(msg, arrays)
+                        wire.send_msg(f, reply, out)
         except (ConnectionError, OSError):
             pass
 

@@ -26,6 +26,7 @@ from typing import Any, BinaryIO, Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK
 
 __all__ = ["send_msg", "recv_msg"]
@@ -48,6 +49,12 @@ def send_msg(f: BinaryIO, header: Dict[str, Any],
         blobs.append(a.tobytes())
     msg = dict(header)
     msg["arrays"] = desc
+    # distributed trace context rides the framing layer (declared in
+    # base/wire_schemas.WIRE_FRAMING), so every PS hop is correlated
+    # without per-call-site plumbing; a no-op when DMLC_TRACE is off
+    trace = _tracectx.current_header()
+    if trace is not None:
+        msg.setdefault(_tracectx.WIRE_KEY, trace)
     f.write(json.dumps(msg).encode() + b"\n")
     for b in blobs:
         f.write(b)

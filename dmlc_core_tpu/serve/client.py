@@ -39,6 +39,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK
 from dmlc_core_tpu.base.resilience import (CircuitBreaker, CircuitOpenError,
                                            RetryPolicy)
@@ -143,11 +144,15 @@ class ResilientClient:
                         f"circuit open for every endpoint (at {ep})")
                 # predict is idempotent (pure function of the rows), so
                 # the POST may retry ambiguous transport failures too
-                out = http_request(
-                    method, ep + path,
-                    {"Content-Type": "application/json"} if body else None,
-                    body, ok=(200,), retry=_ONE_ATTEMPT,
-                    idempotent=True, op=op)
+                with _tracectx.span(f"client.{op}", endpoint=ep) as ctx:
+                    hdrs = ({"Content-Type": "application/json"}
+                            if body else {})
+                    if ctx is not None:
+                        hdrs[_tracectx.HTTP_HEADER] = ctx.encode()
+                    out = http_request(
+                        method, ep + path, hdrs or None,
+                        body, ok=(200,), retry=_ONE_ATTEMPT,
+                        idempotent=True, op=op)
             except CircuitOpenError:
                 self._next_endpoint(advance=True)
                 raise

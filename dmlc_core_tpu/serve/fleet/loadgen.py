@@ -111,6 +111,8 @@ def loadgen_worker(cfg_path: str) -> int:
 
     with open(cfg_path) as f:
         cfg = json.load(f)
+    from dmlc_core_tpu.base import metrics_agg as _agg
+    _agg.install_spool("loadgen", int(cfg.get("seed", 0)))
     data = np.load(cfg["expected_npz"])
     X = np.asarray(data["X"], np.float32)
     expected = {int(k[1:]): np.asarray(data[k], np.float32)

@@ -162,7 +162,8 @@ class ReplicaFrontend(ServeFrontend):
         doc["queue_wait_p99_s"] = p99
         return doc
 
-    def _route(self, method: str, path: str, body: bytes
+    def _route(self, method: str, path: str, body: bytes,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, Any, str, Dict[str, str]]:
         if path.startswith("/admin/"):
             if method != "POST":
@@ -174,7 +175,7 @@ class ReplicaFrontend(ServeFrontend):
             except Exception as e:  # noqa: BLE001 — bad admin call != crash
                 return (400, {"error": f"{type(e).__name__}: {e}"},
                         "application/json", {})
-        return super()._route(method, path, body)
+        return super()._route(method, path, body, headers)
 
     def _handle_admin(self, path: str, payload: Dict[str, Any]
                       ) -> Tuple[int, Any, str, Dict[str, str]]:
@@ -357,6 +358,8 @@ def replica_main(argv: Optional[List[str]] = None) -> int:
         max_batch=int(os.environ.get("FLEET_MAX_BATCH", "64")),
         max_delay=float(os.environ.get("FLEET_MAX_DELAY", "0.002")),
         max_queue=int(os.environ.get("FLEET_MAX_QUEUE", "256")))
+    from dmlc_core_tpu.base import metrics_agg as _agg
+    _agg.install_spool("replica", replica.rank)
     signal.signal(signal.SIGTERM, lambda *_: replica.stop())
     replica.run()
     replica.close(clean=True)

@@ -43,6 +43,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG
 from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.resilience import CircuitBreaker, RetryPolicy
@@ -231,7 +232,8 @@ class FleetRouter(HttpServer):
             p = path if path in ("/predict", "/healthz", "/metrics") else "other"
             fleet_metrics()["router_e2e"].observe(seconds, path=p)
 
-    def _route(self, method: str, path: str, body: bytes
+    def _route(self, method: str, path: str, body: bytes,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, Any, str, Dict[str, str]]:
         if path == "/predict":
             if method != "POST":
@@ -248,10 +250,15 @@ class FleetRouter(HttpServer):
             text = _metrics.default_registry().to_prometheus()
             return (200, text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", {})
-        return super()._route(method, path, body)
+        return super()._route(method, path, body, headers)
 
     def _route_predict(self, body: bytes
                        ) -> Tuple[int, Any, str, Dict[str, str]]:
+        with _tracectx.span("fleet.route"):
+            return self._route_predict_traced(body)
+
+    def _route_predict_traced(self, body: bytes
+                              ) -> Tuple[int, Any, str, Dict[str, str]]:
         m = fleet_metrics() if _metrics.enabled() else None
         with self._lock:
             routable = self._routable_locked()
@@ -279,11 +286,15 @@ class FleetRouter(HttpServer):
                     m["failover"].inc(1, reason="open")
                 continue
             try:
-                _, _, data = http_request(
-                    "POST", url + "/predict",
-                    {"Content-Type": "application/json"}, body,
-                    ok=(200,), retry=_ONE_ATTEMPT, idempotent=True,
-                    op="fleet_route")
+                with _tracectx.span("fleet.forward",
+                                    replica=str(rank)) as fwd:
+                    hdrs_out = {"Content-Type": "application/json"}
+                    if fwd is not None:
+                        hdrs_out[_tracectx.HTTP_HEADER] = fwd.encode()
+                    _, _, data = http_request(
+                        "POST", url + "/predict", hdrs_out, body,
+                        ok=(200,), retry=_ONE_ATTEMPT, idempotent=True,
+                        op="fleet_route")
             except HttpError as e:
                 if e.status == 503:
                     # alive-but-shedding: NOT a breaker failure (see

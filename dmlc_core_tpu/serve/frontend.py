@@ -47,6 +47,7 @@ import numpy as np
 
 from dmlc_core_tpu.base import faultinject as _fi
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG
 from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.serve.batcher import (BatcherClosedError, DynamicBatcher,
@@ -130,10 +131,12 @@ class HttpServer:
         self.close()
 
     # -- hooks -----------------------------------------------------------
-    def _route(self, method: str, path: str, body: bytes
+    def _route(self, method: str, path: str, body: bytes,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, Any, str, Dict[str, str]]:
         """Handle one request → ``(code, payload, content_type,
-        extra_headers)``; ``payload`` is JSON-dumped unless bytes."""
+        extra_headers)``; ``payload`` is JSON-dumped unless bytes.
+        ``headers`` are the request headers, lowercased."""
         return 404, {"error": f"no route {path}"}, "application/json", {}
 
     def _observe(self, path: str, code: int, seconds: float) -> None:
@@ -165,8 +168,20 @@ class HttpServer:
             parsed = self._read_request(conn)
             if parsed is None:
                 return
-            method, path, body = parsed
-            code, payload, ctype, headers = self._route(method, path, body)
+            method, path, req_headers, body = parsed
+            # join the caller's distributed trace (X-Dmlc-Trace) and
+            # wrap the whole handler in this hop's span; the context is
+            # echoed back so clients can correlate responses.  All of
+            # this is a no-op when DMLC_TRACE is off.
+            inbound = req_headers.get(_tracectx.HTTP_HEADER.lower(), "")
+            with _tracectx.attach(inbound):
+                with _tracectx.span(f"http.{path}",
+                                    server=self.name) as ctx:
+                    code, payload, ctype, headers = self._route(
+                        method, path, body, req_headers)
+                    if ctx is not None:
+                        headers = dict(headers)
+                        headers[_tracectx.HTTP_HEADER] = ctx.encode()
             self._respond(conn, code, payload, ctype, headers)
         except Exception:  # noqa: BLE001 — client went away / raw-socket
             pass           # garbage: nothing useful to answer
@@ -180,7 +195,8 @@ class HttpServer:
 
     @staticmethod
     def _read_request(conn: socket.socket
-                      ) -> Optional[Tuple[str, str, bytes]]:
+                      ) -> Optional[Tuple[str, str, Dict[str, str],
+                                          bytes]]:
         conn.settimeout(10.0)
         data = b""
         while b"\r\n\r\n" not in data:
@@ -206,7 +222,7 @@ class HttpServer:
             if not chunk:
                 break
             body += chunk
-        return method, target.split("?", 1)[0], body
+        return method, target.split("?", 1)[0], headers, body
 
     @staticmethod
     def _respond(conn: socket.socket, code: int, payload: Any,
@@ -305,7 +321,8 @@ class ServeFrontend(HttpServer):
             m["e2e"].observe(seconds, path=p)
 
     # -- routing ---------------------------------------------------------
-    def _route(self, method: str, path: str, body: bytes
+    def _route(self, method: str, path: str, body: bytes,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, Any, str, Dict[str, str]]:
         if path == "/predict":
             if method != "POST":
@@ -333,7 +350,7 @@ class ServeFrontend(HttpServer):
             text = _metrics.default_registry().to_prometheus()
             return (200, text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", {})
-        return super()._route(method, path, body)
+        return super()._route(method, path, body, headers)
 
     def _health(self) -> Dict[str, Any]:
         version = self.registry.current_version()
@@ -387,8 +404,10 @@ class ServeFrontend(HttpServer):
             return (400, {"error": f"bad request: {e}"},
                     "application/json", {})
         try:
-            fut = self._batcher.submit(rows, timeout=timeout)
-            preds, version = fut.result(timeout=timeout + 5.0)
+            with _tracectx.span("batcher.submit",
+                                batcher=self._batcher.name):
+                fut = self._batcher.submit(rows, timeout=timeout)
+                preds, version = fut.result(timeout=timeout + 5.0)
         except QueueFullError:
             return (503, {"error": "queue full"},
                     "application/json", {"Retry-After": "1"})

@@ -23,6 +23,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base import metrics as _metrics
+from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG, log_fatal
 from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.timer import get_time
@@ -185,7 +186,13 @@ class RabitTracker:
                         # a garbled line is not a death certificate: skip it
                         LOG("WARNING", "tracker: bad worker message: %s", e)
                         continue
-                    reply = self._handle(msg, conn, state)
+                    # adopt the worker's trace context (the optional
+                    # "trace" framing field) so tracker-side handling
+                    # lands in the same distributed trace
+                    with _tracectx.attach(msg.get(_tracectx.WIRE_KEY)), \
+                            _tracectx.span(
+                                f"tracker.{msg.get('cmd')}"):
+                        reply = self._handle(msg, conn, state)
                     if reply is not None:
                         conn.sendall(json.dumps(reply).encode() + b"\n")
                     if state["clean"]:
@@ -494,6 +501,10 @@ class WorkerSession:
             log_fatal("tracker rejected worker: %s" % self.info["error"])
 
     def _request(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        trace = _tracectx.current_header()
+        if trace is not None:
+            msg = dict(msg)
+            msg.setdefault(_tracectx.WIRE_KEY, trace)
         self._sock.sendall(json.dumps(msg).encode() + b"\n")
         buf = b""
         while b"\n" not in buf:

@@ -36,6 +36,7 @@ import contextlib
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from dmlc_core_tpu.base.timer import get_time
@@ -130,9 +131,27 @@ class Tracer:
     def __init__(self, max_events: int = 200_000) -> None:
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
+        # _t0 (monotonic) timestamps events; _wall0 is the SAME instant
+        # on the wall clock, so cross-process merges (trace_collect) can
+        # line shards up on a shared epoch despite per-process _t0s
         self._t0 = get_time()
+        self._wall0 = time.time()
         self._max_events = max_events
         self.dropped = 0
+        #: process identity stamped into saved traces (set_meta)
+        self.role = ""
+        self.rank = -1
+
+    def set_meta(self, role: Optional[str] = None,
+                 rank: Optional[int] = None) -> None:
+        """Stamp this process's fleet identity (role/rank) into every
+        subsequent :meth:`save` — the Perfetto ``process_name`` row and
+        the merge metadata ``trace_collect`` keys shards by."""
+        with self._lock:
+            if role is not None:
+                self.role = str(role)
+            if rank is not None:
+                self.rank = int(rank)
 
     def _us(self) -> float:
         return (get_time() - self._t0) * 1e6
@@ -181,12 +200,41 @@ class Tracer:
             self._events.clear()
             self.dropped = 0
 
+    @staticmethod
+    def _metadata_events(events: List[Dict[str, Any]], role: str,
+                         rank: int) -> List[Dict[str, Any]]:
+        """Chrome-trace "M" metadata rows: without them, two processes'
+        traces opened together in Perfetto are indistinguishable."""
+        pid = os.getpid()
+        pname = (f"{role}-{rank}" if role else "process")
+        meta: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{pname} pid={pid}"},
+        }]
+        tids = {ev["tid"] for ev in events if "tid" in ev}
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid in sorted(tids):
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": names.get(tid, f"thread-{tid}")},
+            })
+        return meta
+
     def save(self, path: str) -> str:
         with self._lock:
-            payload: Dict[str, Any] = {"traceEvents": list(self._events),
-                                       "displayTimeUnit": "ms"}
-            if self.dropped:
-                payload["otherData"] = {"dropped_events": self.dropped}
+            events = list(self._events)
+            payload: Dict[str, Any] = {
+                "traceEvents": self._metadata_events(
+                    events, self.role, self.rank) + events,
+                "displayTimeUnit": "ms",
+                "otherData": {
+                    "dropped_events": self.dropped,
+                    "epoch_us": self._wall0 * 1e6,
+                    "pid": os.getpid(),
+                    "role": self.role,
+                    "rank": self.rank,
+                },
+            }
         d = os.path.dirname(os.path.abspath(path))
         if d:
             os.makedirs(d, exist_ok=True)

@@ -83,6 +83,8 @@ def worker_main() -> None:
     port = int(os.environ["ELASTIC_TRACKER_PORT"])
     out_dir = os.environ["ELASTIC_OUT"]
     rank = int(os.environ.get("ELASTIC_RANK", "-1"))
+    from dmlc_core_tpu.base import metrics_agg
+    metrics_agg.install_spool("elastic_worker", max(rank, 0))
     X, y = _dataset()
 
     sess = ElasticSession("127.0.0.1", port, rank=rank)
@@ -177,14 +179,21 @@ def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
     os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    # observability plane: parent + worker subprocesses spool metrics
+    # snapshots into one directory (children inherit the env)
+    spool = os.environ.get("DMLC_METRICS_SPOOL") \
+        or tempfile.mkdtemp(prefix="dmlc_elastic_spool")
+    os.environ["DMLC_METRICS_SPOOL"] = spool
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
-    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
+    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
+                                    racecheck)
     from dmlc_core_tpu.base.metrics import default_registry
     from dmlc_core_tpu.parallel.recovery import ElasticTracker
 
+    spool_writer = metrics_agg.install_spool("drill", 0)
     reg = default_registry()
     deaths = reg.counter("worker_deaths_total", labels=("outcome",))
     reshards = reg.counter("elastic_reshards_total")
@@ -279,6 +288,15 @@ def main() -> None:
            "evict: dmlc_elastic_reshards_total counted")
     _check(_metric_total(deaths, outcome="evicted") >= 1,
            "evict: dmlc_worker_deaths_total{outcome=evicted} counted")
+
+    if spool_writer is not None:
+        spool_writer.close()
+    merged, nprocs = metrics_agg.merge_spool(spool)
+    metrics_out = os.environ.get("ELASTIC_METRICS_OUT",
+                                 "/tmp/elastic_metrics.json")
+    metrics_agg.write_snapshot(metrics_out, merged)
+    _check(nprocs >= 1, f"metrics spool merged {nprocs} processes "
+                        f"(artifact at {metrics_out})")
 
     lockcheck.check()
     print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")

@@ -64,13 +64,21 @@ def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
     os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    # observability plane: every process (parent router, replicas,
+    # loadgen workers) spools metrics + trace shards into one directory
+    os.environ.setdefault("DMLC_TRACE", "1")
+    spool = os.environ.get("DMLC_METRICS_SPOOL") \
+        or tempfile.mkdtemp(prefix="dmlc_fleet_spool")
+    os.environ["DMLC_METRICS_SPOOL"] = spool
+    t_drill0 = time.time()
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
     import numpy as np
 
-    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
+    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
+                                    racecheck, slo)
     from dmlc_core_tpu.models import HistGBT
     from dmlc_core_tpu.serve import checkpoint_model
     from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
@@ -79,6 +87,10 @@ def main() -> None:
                                            run_loadgen, spawn_replica)
     from dmlc_core_tpu.serve.client import ResilientClient
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_collect
+
+    spool_writer = metrics_agg.install_spool("drill", 0)
     out_path = os.environ.get("FLEET_OUT", "/tmp/fleet_drill.json")
     report = {"phases": {}}
     tmp = tempfile.mkdtemp(prefix="dmlc_fleet")
@@ -96,7 +108,8 @@ def main() -> None:
     np.savez(expected_npz, X=X, v1=m1.predict(X), v2=m2.predict(X))
 
     child_env = {"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
-                 "DMLC_LOCKCHECK": "1", "DMLC_RACECHECK": "1"}
+                 "DMLC_LOCKCHECK": "1", "DMLC_RACECHECK": "1",
+                 "DMLC_TRACE": "1", "DMLC_METRICS_SPOOL": spool}
     tracker = FleetTracker(nworker=8)
     tracker.start()
     procs = [spawn_replica("127.0.0.1", tracker.port, model_uri=v1_uri,
@@ -219,6 +232,50 @@ def main() -> None:
                     p.kill()
         tracker.stop()
 
+    # -- observability plane: merge spools, stitch the trace -------------
+    if spool_writer is not None:
+        spool_writer.close()    # final parent snapshot + trace shard
+    drill_wall_s = time.time() - t_drill0
+    merged, nprocs = metrics_agg.merge_spool(spool)
+    metrics_out = os.environ.get("FLEET_METRICS_OUT",
+                                 "/tmp/fleet_metrics.json")
+    metrics_agg.write_snapshot(metrics_out, merged)
+    _check(nprocs >= 3,
+           f"metrics spool merged {nprocs} processes "
+           f"(artifact at {metrics_out})")
+    # merged request counters must equal the per-process sum EXACTLY
+    shard_sum = 0.0
+    for fname in merged["spool_files"]:
+        with open(os.path.join(spool, fname)) as f:
+            snap = json.load(f)
+        m = (snap.get("metrics") or {}).get("dmlc_serve_requests_total")
+        shard_sum += sum(s["value"] for s in (m or {}).get("series", ()))
+    merged_m = merged["metrics"].get("dmlc_serve_requests_total", {})
+    merged_sum = sum(s["value"] for s in merged_m.get("series", ()))
+    _check(merged_sum == shard_sum and merged_sum > 0,
+           f"merged dmlc_serve_requests_total == per-process sum "
+           f"({merged_sum:.0f})")
+
+    t_tc0 = time.time()
+    trace_out = os.environ.get("FLEET_TRACE_OUT", "/tmp/fleet_trace.json")
+    _, tsummary = trace_collect.collect(spool, trace_out)
+    trace_collect_s = time.time() - t_tc0
+    cross = {tid: t for tid, t in tsummary["traces"].items()
+             if len(t["pids"]) >= 3 and "fleet.route" in t["spans"]
+             and "batcher.submit" in t["spans"]
+             and any(s.startswith("http./predict") for s in t["spans"])}
+    _check(cross,
+           f"{len(cross)} request trace(s) crossed router -> replica -> "
+           f"batcher spans over >= 3 processes (merged Perfetto trace "
+           f"at {trace_out})")
+    report["observability"] = {
+        "spool_processes_merged": nprocs,
+        "traces": len(tsummary["traces"]),
+        "cross_process_traces": len(cross),
+        "trace_collect_s": round(trace_collect_s, 3),
+        "drill_wall_s": round(drill_wall_s, 3),
+    }
+
     with open(out_path, "w") as f:
         json.dump(report, f, indent=2)
     print(f"   report archived to {out_path}")
@@ -226,16 +283,37 @@ def main() -> None:
     print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
     rc_out = os.environ.get("FLEET_RACECHECK_OUT",
                             "/tmp/fleet_racecheck.json")
-    racecheck.write_report(rc_out)
+    rc_report = racecheck.write_report(rc_out)
     racecheck.check()
     print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
           f"(parent; report at {rc_out})")
     lk_out = os.environ.get("FLEET_LEAKCHECK_OUT",
                             "/tmp/fleet_leakcheck.json")
-    leakcheck.write_report(lk_out)
+    lk_report = leakcheck.write_report(lk_out)
     leakcheck.check()
     print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
           f"(parent; report at {lk_out})")
+
+    # -- SLO scorecard gate ----------------------------------------------
+    spec_path = os.environ.get("FLEET_SLO_SPEC") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "slo", "fleet.json")
+    evidence = {
+        "loadgen": report["phases"]["rollout"]["load"],
+        "racecheck": {"races": len(rc_report["races"])},
+        "leakcheck": {"leaks": len(lk_report["leaks"])},
+    }
+    scorecard = slo.evaluate(slo.SLOSpec.load(spec_path), merged, evidence)
+    slo_out = os.environ.get("FLEET_SLO_OUT", "/tmp/fleet_slo.json")
+    with open(slo_out, "w") as f:
+        json.dump(scorecard, f, indent=2)
+    for row in scorecard["objectives"]:
+        print(f"   slo[{row['name']}]: "
+              f"{'pass' if row['pass'] else 'FAIL'} "
+              f"(observed {row['observed']} {row['op']} "
+              f"{row['threshold']}; {row['evidence']})")
+    _check(scorecard["pass"],
+           f"SLO scorecard {scorecard['spec']} green "
+           f"(spec {spec_path}, scorecard at {slo_out})")
     print("FLEET CHAOS DRILL GREEN")
 
 

@@ -77,6 +77,10 @@ def worker_main() -> None:
     port = int(os.environ["DMLC_TRACKER_PORT"])
     rank = int(os.environ["DMLC_TASK_ID"])
     out_dir = os.environ["LAUNCH_OUT"]
+    # DMLC_METRICS_SPOOL arrives via JobSet.worker_env's observability
+    # overlay — the spool install exercises that injection path
+    from dmlc_core_tpu.base import metrics_agg
+    metrics_agg.install_spool("launch_worker", rank)
     X, y = _dataset()
 
     sess = ElasticSession(os.environ["DMLC_TRACKER_URI"], port, rank=rank)
@@ -158,14 +162,22 @@ def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
     os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    # observability plane: JobSet children inherit the spool through
+    # worker_env's injection; the parent spools its own registry too
+    spool = os.environ.get("DMLC_METRICS_SPOOL") \
+        or tempfile.mkdtemp(prefix="dmlc_launch_spool")
+    os.environ["DMLC_METRICS_SPOOL"] = spool
     from dmlc_core_tpu.utils import force_cpu_devices
 
     force_cpu_devices(1)
 
     import numpy as np
 
-    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
+    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
+                                    racecheck)
     from dmlc_core_tpu.launch import launch_metrics
+
+    spool_writer = metrics_agg.install_spool("drill", 0)
 
     tmp = tempfile.mkdtemp(prefix="dmlc_launch")
 
@@ -270,6 +282,16 @@ def main() -> None:
             router.close()
         scaler.reap(timeout=15)
         tracker.stop()
+
+    if spool_writer is not None:
+        spool_writer.close()
+    merged, nprocs = metrics_agg.merge_spool(spool)
+    metrics_out = os.environ.get("LAUNCH_METRICS_OUT",
+                                 "/tmp/launch_metrics.json")
+    metrics_agg.write_snapshot(metrics_out, merged)
+    _check(nprocs >= 2,
+           f"metrics spool merged {nprocs} processes (JobSet children "
+           f"joined via worker_env injection; artifact at {metrics_out})")
 
     lockcheck.check()
     print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")

@@ -247,8 +247,21 @@ def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
     os.environ.setdefault("DMLC_LEAKCHECK", "1")
-    from dmlc_core_tpu.base import leakcheck, lockcheck, racecheck
+    # observability plane: scheduler parent, PS servers and workers all
+    # spool metrics + trace shards into one directory (children inherit
+    # the env through _launch)
+    os.environ.setdefault("DMLC_TRACE", "1")
+    spool = os.environ.get("DMLC_METRICS_SPOOL") \
+        or tempfile.mkdtemp(prefix="dmlc_ps_spool")
+    os.environ["DMLC_METRICS_SPOOL"] = spool
+    t_drill0 = time.time()
+    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
+                                    racecheck, slo)
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_collect
+
+    spool_writer = metrics_agg.install_spool("drill", 0)
     tmp = tempfile.mkdtemp(prefix="dmlc_ps_drill")
     staleness_bound = int(os.environ.get("DMLC_PS_STALENESS", 4))
 
@@ -280,18 +293,80 @@ def main() -> None:
                f"kill: worker {rank} staleness {st['staleness_max']} "
                f"<= bound {staleness_bound}")
 
+    # -- observability plane: merge spools, stitch the trace -------------
+    if spool_writer is not None:
+        spool_writer.close()    # final parent snapshot + trace shard
+    drill_wall_s = time.time() - t_drill0
+    merged, nprocs = metrics_agg.merge_spool(spool)
+    metrics_out = os.environ.get("PS_METRICS_OUT", "/tmp/ps_metrics.json")
+    metrics_agg.write_snapshot(metrics_out, merged)
+    _check(nprocs >= 3,
+           f"metrics spool merged {nprocs} processes "
+           f"(artifact at {metrics_out})")
+    t_tc0 = time.time()
+    trace_out = os.environ.get("PS_TRACE_OUT", "/tmp/ps_trace.json")
+    _, tsummary = trace_collect.collect(spool, trace_out)
+    trace_collect_s = time.time() - t_tc0
+    cross = {tid: t for tid, t in tsummary["traces"].items()
+             if len(t["pids"]) >= 2 and "ps.push" in t["spans"]
+             and "ps.server.push" in t["spans"]}
+    _check(cross,
+           f"{len(cross)} trace(s) followed a push worker -> server "
+           f"across processes (merged Perfetto trace at {trace_out})")
+
     lockcheck.check()
     print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
     rc_out = os.environ.get("PS_RACECHECK_OUT", "/tmp/ps_racecheck.json")
-    racecheck.write_report(rc_out)
+    rc_report = racecheck.write_report(rc_out)
     racecheck.check()
     print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
           f"(parent; report at {rc_out})")
     lk_out = os.environ.get("PS_LEAKCHECK_OUT", "/tmp/ps_leakcheck.json")
-    leakcheck.write_report(lk_out)
+    lk_report = leakcheck.write_report(lk_out)
     leakcheck.check()
     print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
           f"(parent; report at {lk_out})")
+
+    # -- SLO scorecard gate ----------------------------------------------
+    spec_path = os.environ.get("PS_SLO_SPEC") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "slo", "ps.json")
+    evidence = {
+        "workers": {
+            "min_accuracy": min(st["accuracy"] for st in base.values()),
+            "staleness_max": max(st["staleness_max"]
+                                 for stats in (base, kill)
+                                 for st in stats.values()),
+        },
+        "respawn": respawn,
+        "racecheck": {"races": len(rc_report["races"])},
+        "leakcheck": {"leaks": len(lk_report["leaks"])},
+    }
+    scorecard = slo.evaluate(slo.SLOSpec.load(spec_path), merged, evidence)
+    slo_out = os.environ.get("PS_SLO_OUT", "/tmp/ps_slo.json")
+    with open(slo_out, "w") as f:
+        json.dump(scorecard, f, indent=2)
+    for row in scorecard["objectives"]:
+        print(f"   slo[{row['name']}]: "
+              f"{'pass' if row['pass'] else 'FAIL'} "
+              f"(observed {row['observed']} {row['op']} "
+              f"{row['threshold']}; {row['evidence']})")
+    _check(scorecard["pass"],
+           f"SLO scorecard {scorecard['spec']} green "
+           f"(spec {spec_path}, scorecard at {slo_out})")
+    report_out = os.environ.get("PS_DRILL_OUT", "/tmp/ps_drill.json")
+    with open(report_out, "w") as f:
+        json.dump({
+            "baseline": base, "kill": kill, "respawn": respawn,
+            "observability": {
+                "spool_processes_merged": nprocs,
+                "traces": len(tsummary["traces"]),
+                "cross_process_traces": len(cross),
+                "trace_collect_s": round(trace_collect_s, 3),
+                "drill_wall_s": round(drill_wall_s, 3),
+            },
+            "slo": scorecard,
+        }, f, indent=2)
+    print(f"   report archived to {report_out}")
     print("PS CHAOS DRILL GREEN")
 
 

@@ -46,8 +46,10 @@ def _state(version):
 def writer_main(uri, version):
     """Child entry: save one checkpoint (the parent may have armed
     DMLC_FAULT_INJECT to SIGKILL us mid-write)."""
+    from dmlc_core_tpu.base import metrics_agg
     from dmlc_core_tpu.parallel.checkpoint import checkpoint
 
+    metrics_agg.install_spool("ckpt_writer", version)
     checkpoint(uri, _state(version), version=version)
 
 
@@ -228,9 +230,25 @@ def main():
         return
     import tempfile
 
+    # observability plane: parent + writer children spool metrics
+    # snapshots into one directory (children inherit the env)
+    spool = os.environ.get("DMLC_METRICS_SPOOL") \
+        or tempfile.mkdtemp(prefix="dmlc_resilience_spool")
+    os.environ["DMLC_METRICS_SPOOL"] = spool
+    from dmlc_core_tpu.base import metrics_agg
+
+    spool_writer = metrics_agg.install_spool("drill", 0)
     with tempfile.TemporaryDirectory(prefix="dmlc_resilience") as tmpdir:
         drill_checkpoint(tmpdir)
     drill_lossy_wire()
+    if spool_writer is not None:
+        spool_writer.close()
+    merged, nprocs = metrics_agg.merge_spool(spool)
+    metrics_out = os.environ.get("RESILIENCE_METRICS_OUT",
+                                 "/tmp/resilience_metrics.json")
+    metrics_agg.write_snapshot(metrics_out, merged)
+    _check(nprocs >= 2, f"metrics spool merged {nprocs} processes "
+                        f"(artifact at {metrics_out})")
     print("RESILIENCE SMOKE GREEN")
 
 

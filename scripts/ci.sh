@@ -115,8 +115,12 @@ echo "== resilience smoke (kill-and-recover + lossy wire) =="
 # mid-write and prove the previous version survives bit-identically,
 # then push an S3 round-trip through injected 503s/truncations and
 # prove byte identity + retry/fault evidence on the metrics registry
-# (the doc/robustness.md contract).
-env JAX_PLATFORMS=cpu python scripts/check_resilience.py
+# (the doc/robustness.md contract).  The drill also merges its metrics
+# spool (parent + checkpoint-writer children) into one archived fleet
+# snapshot.
+env JAX_PLATFORMS=cpu \
+    RESILIENCE_METRICS_OUT="${RESILIENCE_METRICS_OUT:-/tmp/resilience_metrics.json}" \
+    python scripts/check_resilience.py
 
 echo "== elastic recovery chaos drill (die / rejoin / catch-up + evict) =="
 # n=4 local worker processes co-training over tracker-hub collectives;
@@ -129,9 +133,11 @@ echo "== elastic recovery chaos drill (die / rejoin / catch-up + evict) =="
 # gates GREEN on zero live resource leaks at exit; the racecheck and
 # leakcheck JSON are archived like the drill report (doc/robustness.md
 # "Distributed recovery").
+# The merged cross-process metrics snapshot is archived next to them.
 env JAX_PLATFORMS=cpu \
     ELASTIC_RACECHECK_OUT="${ELASTIC_RACECHECK_OUT:-/tmp/elastic_racecheck.json}" \
     ELASTIC_LEAKCHECK_OUT="${ELASTIC_LEAKCHECK_OUT:-/tmp/elastic_leakcheck.json}" \
+    ELASTIC_METRICS_OUT="${ELASTIC_METRICS_OUT:-/tmp/elastic_metrics.json}" \
     python scripts/check_elastic.py
 
 echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
@@ -146,10 +152,28 @@ echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
 # happens-before races and zero live resource leaks at exit; the
 # racecheck and leakcheck JSON are archived alongside
 # (doc/serving.md "Fleet serving").
+# The observability plane rides the same run: every process spools its
+# metrics + trace shard, the drill merges them (exact counter sums,
+# one request id crossing >= 3 pids) and gates GREEN on the committed
+# SLO scorecard (scripts/slo/fleet.json); merged metrics, the Perfetto
+# trace and the scorecard are archived next to the race/leak reports.
 env JAX_PLATFORMS=cpu \
     FLEET_RACECHECK_OUT="${FLEET_RACECHECK_OUT:-/tmp/fleet_racecheck.json}" \
     FLEET_LEAKCHECK_OUT="${FLEET_LEAKCHECK_OUT:-/tmp/fleet_leakcheck.json}" \
+    FLEET_METRICS_OUT="${FLEET_METRICS_OUT:-/tmp/fleet_metrics.json}" \
+    FLEET_TRACE_OUT="${FLEET_TRACE_OUT:-/tmp/fleet_trace.json}" \
+    FLEET_SLO_OUT="${FLEET_SLO_OUT:-/tmp/fleet_slo.json}" \
     python scripts/check_fleet.py
+# trace-collection cost budget: merging the shards must stay under 5%
+# of the drill's wall time, or the plane is taxing the thing it watches
+python - "${FLEET_OUT:-/tmp/fleet_drill.json}" <<'EOF'
+import json, sys
+obs = json.load(open(sys.argv[1]))["observability"]
+frac = obs["trace_collect_s"] / max(obs["drill_wall_s"], 1e-9)
+print(f"trace collect: {obs['trace_collect_s']:.2f}s "
+      f"of {obs['drill_wall_s']:.1f}s drill wall ({frac:.1%})")
+sys.exit(1 if frac > 0.05 else 0)
+EOF
 
 echo "== parameter-server chaos drill (kill server / respawn / restore) =="
 # scheduler + 2 server + 3 worker processes training sparse GBLinear
@@ -163,10 +187,24 @@ echo "== parameter-server chaos drill (kill server / respawn / restore) =="
 # DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1 with zero order cycles and zero
 # happens-before races, plus DMLC_LEAKCHECK=1 zero-leak gating in the
 # parent (doc/distributed.md "Parameter server").
+# Observability plane: worker ps.push -> server ps.server.push traces
+# across pids, merged fleet metrics, and the committed SLO gate
+# (scripts/slo/ps.json) — artifacts archived alongside.
 env JAX_PLATFORMS=cpu \
     PS_RACECHECK_OUT="${PS_RACECHECK_OUT:-/tmp/ps_racecheck.json}" \
     PS_LEAKCHECK_OUT="${PS_LEAKCHECK_OUT:-/tmp/ps_leakcheck.json}" \
+    PS_METRICS_OUT="${PS_METRICS_OUT:-/tmp/ps_metrics.json}" \
+    PS_TRACE_OUT="${PS_TRACE_OUT:-/tmp/ps_trace.json}" \
+    PS_SLO_OUT="${PS_SLO_OUT:-/tmp/ps_slo.json}" \
     python scripts/check_ps.py
+python - "${PS_DRILL_OUT:-/tmp/ps_drill.json}" <<'EOF'
+import json, sys
+obs = json.load(open(sys.argv[1]))["observability"]
+frac = obs["trace_collect_s"] / max(obs["drill_wall_s"], 1e-9)
+print(f"trace collect: {obs['trace_collect_s']:.2f}s "
+      f"of {obs['drill_wall_s']:.1f}s drill wall ({frac:.1%})")
+sys.exit(1 if frac > 0.05 else 0)
+EOF
 
 echo "== multi-host launch drill (fake cluster / host death / respawn) =="
 # supervised launch over a FakeTransport "cluster" of 3 virtual hosts:
@@ -180,9 +218,12 @@ echo "== multi-host launch drill (fake cluster / host death / respawn) =="
 # DMLC_RACECHECK=1 with zero order cycles and zero happens-before
 # races, plus DMLC_LEAKCHECK=1 zero-leak gating; racecheck and
 # leakcheck JSON archived (doc/distributed.md "Multi-host launch").
+# Spool delivery to JobSet children goes through worker_env injection;
+# the merged metrics snapshot is archived next to the race/leak reports.
 env JAX_PLATFORMS=cpu \
     LAUNCH_RACECHECK_OUT="${LAUNCH_RACECHECK_OUT:-/tmp/launch_racecheck.json}" \
     LAUNCH_LEAKCHECK_OUT="${LAUNCH_LEAKCHECK_OUT:-/tmp/launch_leakcheck.json}" \
+    LAUNCH_METRICS_OUT="${LAUNCH_METRICS_OUT:-/tmp/launch_metrics.json}" \
     python scripts/check_launch.py
 
 if [[ "${1:-}" != "quick" ]]; then

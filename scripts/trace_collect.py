@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Merge per-process trace shards into one Perfetto timeline.
+
+Every process that ran with ``DMLC_TRACE=1`` and a metrics spool
+(``DMLC_METRICS_SPOOL``) saved its Chrome-trace shard to
+``<spool>/trace-<role>-<rank>-<pid>.json`` at exit (see
+``base/metrics_agg.SpoolWriter``).  Each shard's timestamps are relative
+to that process's own monotonic zero; the shard's ``otherData.epoch_us``
+records the same instant on the wall clock.  This collector:
+
+* normalizes every event onto a shared timeline (offset by the shard's
+  epoch relative to the earliest shard's epoch);
+* keeps the per-shard ``process_name``/``thread_name`` metadata rows, so
+  the merged view shows one labelled row group per process;
+* writes one ``chrome://tracing`` / Perfetto JSON file;
+* returns a summary keyed by distributed trace id (``base/tracectx``
+  stamps ``trace``/``span``/``parent`` into span args), listing the
+  pids, roles and span names each request crossed — the artifact the
+  fleet drill asserts "one request id crossed >= 3 processes" against.
+
+Usage::
+
+    python scripts/trace_collect.py <spool_dir> [-o merged.json]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["collect", "load_shards", "main"]
+
+
+def load_shards(spool_dir: str) -> List[Dict[str, Any]]:
+    """Read every ``trace-*.json`` shard in ``spool_dir`` (unparseable
+    files are skipped — a crashed writer must not sink the merge)."""
+    shards = []
+    for path in sorted(glob.glob(os.path.join(spool_dir, "trace-*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+            doc["_path"] = path
+            shards.append(doc)
+    return shards
+
+
+def _merge_events(shards: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    epochs = [float(s.get("otherData", {}).get("epoch_us", 0.0))
+              for s in shards]
+    t0 = min(epochs) if epochs else 0.0
+    merged: List[Dict[str, Any]] = []
+    for shard, epoch in zip(shards, epochs):
+        offset = epoch - t0
+        for ev in shard["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("ph") == "M" or "ts" not in ev:
+                merged.append(ev)  # metadata rows carry no timestamp
+            else:
+                ev = dict(ev)
+                ev["ts"] = float(ev["ts"]) + offset
+                merged.append(ev)
+    return merged
+
+
+def _trace_summary(shards: List[Dict[str, Any]]) -> Dict[str, Any]:
+    traces: Dict[str, Dict[str, Any]] = {}
+    for shard in shards:
+        other = shard.get("otherData", {})
+        role = str(other.get("role", ""))
+        for ev in shard["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            args = ev.get("args") or {}
+            tid = args.get("trace")
+            if not tid:
+                continue
+            entry = traces.setdefault(
+                str(tid), {"pids": set(), "roles": set(), "spans": set()})
+            entry["pids"].add(int(ev.get("pid", other.get("pid", 0))))
+            entry["roles"].add(role or "process")
+            entry["spans"].add(str(ev.get("name", "")))
+    return {tid: {"pids": sorted(e["pids"]),
+                  "roles": sorted(e["roles"]),
+                  "spans": sorted(e["spans"])}
+            for tid, e in traces.items()}
+
+
+def collect(spool_dir: str, out_path: Optional[str] = None
+            ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Merge all trace shards under ``spool_dir``.
+
+    Returns ``(merged_doc, summary)``; ``merged_doc`` is the Perfetto
+    JSON (written to ``out_path`` when given), ``summary`` maps each
+    distributed trace id to the pids/roles/span names it crossed plus
+    top-level ``processes``/``events``/``dropped_events`` totals.
+    """
+    shards = load_shards(spool_dir)
+    events = _merge_events(shards)
+    merged = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "shards": [os.path.basename(s["_path"]) for s in shards],
+            "dropped_events": sum(
+                int(s.get("otherData", {}).get("dropped_events", 0))
+                for s in shards),
+        },
+    }
+    summary = {
+        "processes": len({int(s.get("otherData", {}).get("pid", i))
+                          for i, s in enumerate(shards)}),
+        "events": sum(1 for ev in events if ev.get("ph") != "M"),
+        "dropped_events": merged["otherData"]["dropped_events"],
+        "traces": _trace_summary(shards),
+    }
+    if out_path:
+        d = os.path.dirname(os.path.abspath(out_path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged, summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("spool_dir", help="DMLC_METRICS_SPOOL directory "
+                                      "holding trace-*.json shards")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the merged Perfetto JSON here")
+    args = ap.parse_args(argv)
+    _, summary = collect(args.spool_dir, args.out)
+    json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+    print()
+    return 0 if summary["processes"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

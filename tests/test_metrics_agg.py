@@ -1,0 +1,202 @@
+"""Cross-process metrics aggregation (base/metrics_agg) contracts.
+
+The merge is the trust boundary of the fleet observability plane: the
+drills assert merged counters equal per-process sums EXACTLY, so the
+properties here are stated as equalities, not tolerances — counter-sum
+associativity, histogram bucket-merge == observing the union, label-set
+collisions resolving per series, and the ``DMLC_METRICS=0`` snapshot
+merging as a no-op.  The spool half (write/install/merge_spool) runs
+against a real tmp directory.
+"""
+
+import json
+import os
+
+import pytest
+
+from dmlc_core_tpu.base import metrics as M
+from dmlc_core_tpu.base import metrics_agg as A
+
+BUCKETS = (0.1, 1.0, 10.0)
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    """Enabled collection, a clean default registry, and no ambient
+    spool; the process-wide install singleton is reset afterwards."""
+    monkeypatch.delenv("DMLC_METRICS_SPOOL", raising=False)
+    M.set_enabled(True)
+    M.default_registry().reset()
+    yield
+    installed = A.installed_spool()
+    if installed is not None:
+        installed.close()
+    A._installed = None
+    M.set_enabled(True)
+    M.default_registry().reset()
+
+
+def _snap(fill):
+    """Snapshot of a fresh registry after ``fill(registry)`` ran."""
+    r = M.MetricsRegistry(namespace="dmlc")
+    fill(r)
+    return r.snapshot()
+
+
+def _counter_value(snapshot, name, **labels):
+    for s in snapshot["metrics"][name]["series"]:
+        if s["labels"] == labels:
+            return s["value"]
+    return None
+
+
+class TestCounterMerge:
+    def test_sum_is_exact_and_associative(self):
+        def fill(v):
+            def go(r):
+                r.counter("reqs_total", labels=("path",)).inc(v, path="/p")
+            return go
+
+        a, b, c = _snap(fill(3)), _snap(fill(5)), _snap(fill(11))
+        left = A.merge_snapshots([A.merge_snapshots([a, b]), c])
+        right = A.merge_snapshots([a, A.merge_snapshots([b, c])])
+        assert _counter_value(left, "dmlc_reqs_total", path="/p") == 19
+        assert left["metrics"] == right["metrics"]
+
+    def test_label_collisions_resolve_per_series(self):
+        def fill_a(r):
+            ctr = r.counter("reqs_total", labels=("path", "code"))
+            ctr.inc(2, path="/p", code="200")
+            ctr.inc(1, path="/p", code="500")
+
+        def fill_b(r):
+            ctr = r.counter("reqs_total", labels=("path", "code"))
+            ctr.inc(7, path="/p", code="200")
+            ctr.inc(4, path="/q", code="200")
+
+        merged = A.merge_snapshots([_snap(fill_a), _snap(fill_b)])
+        assert _counter_value(merged, "dmlc_reqs_total",
+                              path="/p", code="200") == 9
+        assert _counter_value(merged, "dmlc_reqs_total",
+                              path="/p", code="500") == 1
+        assert _counter_value(merged, "dmlc_reqs_total",
+                              path="/q", code="200") == 4
+        assert len(merged["metrics"]["dmlc_reqs_total"]["series"]) == 3
+
+    def test_kind_conflict_raises(self):
+        a = _snap(lambda r: r.counter("depth").inc(1))
+        b = _snap(lambda r: r.gauge("depth").set(1))
+        with pytest.raises(ValueError, match="declared as"):
+            A.merge_snapshots([a, b])
+
+
+class TestGaugeMerge:
+    def test_last_write_wins_by_ts(self):
+        a = _snap(lambda r: r.gauge("workers").set(3))
+        b = _snap(lambda r: r.gauge("workers").set(8))
+        # b's snapshot was taken later, so its ts is strictly larger
+        merged = A.merge_snapshots([a, b])
+        assert merged["metrics"]["dmlc_workers"]["series"][0]["value"] == 8
+        # order of the input list must not matter — the ts decides
+        merged = A.merge_snapshots([b, a])
+        assert merged["metrics"]["dmlc_workers"]["series"][0]["value"] == 8
+
+
+class TestHistogramMerge:
+    def test_bucket_merge_equals_observing_union(self):
+        xs = [0.05, 0.5, 0.5, 5.0]
+        ys = [0.07, 2.0, 50.0]
+
+        def observing(values):
+            def go(r):
+                h = r.histogram("wait_seconds", buckets=BUCKETS)
+                for v in values:
+                    h.observe(v)
+            return go
+
+        merged = A.merge_snapshots([_snap(observing(xs)),
+                                    _snap(observing(ys))])
+        union = _snap(observing(xs + ys))
+        got = merged["metrics"]["dmlc_wait_seconds"]["series"][0]
+        want = union["metrics"]["dmlc_wait_seconds"]["series"][0]
+        assert got["buckets"] == want["buckets"]
+        assert got["count"] == want["count"]
+        assert got["sum"] == pytest.approx(want["sum"])
+        assert got["min"] == want["min"]
+        assert got["max"] == want["max"]
+
+    def test_bucket_bounds_mismatch_raises(self):
+        a = _snap(lambda r: r.histogram("h", buckets=(1.0,)).observe(0.5))
+        b = _snap(lambda r: r.histogram("h", buckets=(2.0,)).observe(0.5))
+        with pytest.raises(ValueError, match="bucket bounds"):
+            A.merge_snapshots([a, b])
+
+    def test_merge_is_deterministic(self):
+        def observing(seed):
+            def go(r):
+                h = r.histogram("h", buckets=BUCKETS)
+                for i in range(200):
+                    h.observe((i * seed % 97) / 10.0)
+            return go
+
+        snaps = [_snap(observing(3)), _snap(observing(7))]
+        once = A.merge_snapshots(snaps)
+        twice = A.merge_snapshots(snaps)
+        assert once == twice   # reservoir resampling is seeded
+
+
+class TestDisabledNoOp:
+    def test_disabled_process_snapshot_merges_as_noop(self):
+        real = _snap(lambda r: r.counter("reqs_total").inc(6))
+        M.set_enabled(False)
+        empty = M.MetricsRegistry(namespace="dmlc")
+        empty.counter("reqs_total").inc(100)     # no-op while disabled
+        dark = empty.snapshot()
+        M.set_enabled(True)
+        merged = A.merge_snapshots([real, dark])
+        assert _counter_value(merged, "dmlc_reqs_total") == 6
+
+    def test_install_spool_noop_without_env(self):
+        assert A.install_spool("tester", 0) is None
+        assert A.installed_spool() is None
+
+
+class TestSpool:
+    def test_write_install_merge_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DMLC_METRICS_SPOOL", str(tmp_path))
+        M.default_registry().counter("reqs_total").inc(4)
+        writer = A.install_spool("tester", 2)
+        assert writer is not None
+        assert A.install_spool("other", 9) is writer   # first call wins
+        writer.flush()
+        name = os.path.basename(writer.path)
+        assert name.startswith("tester-2-") and name.endswith(".json")
+        merged, nprocs = A.merge_spool(str(tmp_path))
+        assert nprocs == 1 and merged["spool_files"] == [name]
+        assert _counter_value(merged, "dmlc_reqs_total") == 4
+        # the spool instruments itself: at least the initial + explicit
+        # flushes are counted, and the counter rides the same snapshot
+        assert _counter_value(merged, "dmlc_spool_writes_total",
+                              role="tester") >= 2
+        writer.close()
+        A._installed = None
+
+    def test_merge_spool_skips_foreign_and_trace_files(self, tmp_path):
+        A.write_snapshot(str(tmp_path / "w-0-1.json"),
+                         _snap(lambda r: r.counter("n_total").inc(1)))
+        (tmp_path / "trace-w-0-1.json").write_text(
+            json.dumps({"traceEvents": []}))
+        (tmp_path / "merged_artifact.json").write_text("[1, 2]")
+        (tmp_path / "garbage.json").write_text("{not json")
+        merged, nprocs = A.merge_spool(str(tmp_path))
+        assert nprocs == 1
+        assert _counter_value(merged, "dmlc_n_total") == 1
+
+    def test_disabled_metrics_spools_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DMLC_METRICS_SPOOL", str(tmp_path))
+        M.set_enabled(False)
+        writer = A.SpoolWriter(str(tmp_path), "dark", 0, period_s=0)
+        writer.start()
+        writer.close()
+        assert not [n for n in os.listdir(tmp_path)
+                    if not n.startswith("trace-")]

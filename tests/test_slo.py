@@ -1,0 +1,167 @@
+"""SLO scorecard engine (base/slo) contracts.
+
+The scorecard gates CI drills GREEN, so its failure semantics must be
+exact: malformed committed specs raise at load (not at gate time), an
+objective whose value cannot be resolved FAILS (absent counters read 0;
+absent quantiles/evidence never pass silently), and every row carries
+the evidence pointer a reader needs to audit the verdict.
+"""
+
+import json
+
+import pytest
+
+from dmlc_core_tpu.base import metrics as M
+from dmlc_core_tpu.base import slo
+
+
+def _snapshot():
+    r = M.MetricsRegistry(namespace="dmlc")
+    reqs = r.counter("requests_total", labels=("code",))
+    reqs.inc(90, code="200")
+    reqs.inc(10, code="500")
+    r.gauge("replicas").set(3)
+    h = r.histogram("wait_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.02, 0.02, 0.5):
+        h.observe(v)
+    return r.snapshot()
+
+
+def _spec(*objectives):
+    return slo.SLOSpec("t", objectives)
+
+
+class TestSpecValidation:
+    def test_missing_fields_raise(self):
+        with pytest.raises(ValueError, match="needs name/op"):
+            _spec({"name": "x", "op": "<=", "threshold": 1})
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(ValueError, match="unknown op"):
+            _spec({"name": "x", "op": "~=", "threshold": 1,
+                   "source": {"evidence": "a"}})
+
+    def test_source_must_have_exactly_one_kind(self):
+        for src in ({}, {"metric": "m", "evidence": "e"}, {"other": 1}):
+            with pytest.raises(ValueError, match="exactly one"):
+                _spec({"name": "x", "op": "<=", "threshold": 1,
+                       "source": src})
+
+    def test_ratio_wants_two_valid_sources(self):
+        with pytest.raises(ValueError, match="ratio"):
+            _spec({"name": "x", "op": "<=", "threshold": 1,
+                   "source": {"ratio": [{"evidence": "a"}]}})
+        with pytest.raises(ValueError, match="exactly one"):
+            _spec({"name": "x", "op": "<=", "threshold": 1,
+                   "source": {"ratio": [{"evidence": "a"}, {"bad": 1}]}})
+
+    def test_load_roundtrip(self, tmp_path):
+        p = tmp_path / "spec.json"
+        p.write_text(json.dumps({"name": "fleet", "objectives": [
+            {"name": "ok", "op": ">=", "threshold": 1,
+             "source": {"metric": "dmlc_requests_total"}}]}))
+        spec = slo.SLOSpec.load(str(p))
+        assert spec.name == "fleet" and len(spec.objectives) == 1
+
+
+class TestResolution:
+    def test_counter_sum_with_label_filter(self):
+        card = slo.evaluate(_spec(
+            {"name": "errors", "op": "<=", "threshold": 10,
+             "source": {"metric": "dmlc_requests_total",
+                        "labels": {"code": "500"}}}), _snapshot())
+        obj = card["objectives"][0]
+        assert obj["observed"] == 10 and obj["pass"]
+
+    def test_gauge_value_and_scale(self):
+        card = slo.evaluate(_spec(
+            {"name": "replicas", "op": "==", "threshold": 300,
+             "source": {"metric": "dmlc_replicas", "stat": "value",
+                        "scale": 100}}), _snapshot())
+        assert card["objectives"][0]["pass"]
+
+    def test_histogram_stats(self):
+        snap = _snapshot()
+        for stat, op, threshold in (("count", "==", 4), ("max", "<=", 0.5),
+                                    ("min", ">=", 0.005), ("p99", "<", 1.0)):
+            card = slo.evaluate(_spec(
+                {"name": stat, "op": op, "threshold": threshold,
+                 "source": {"metric": "dmlc_wait_seconds",
+                            "stat": stat}}), snap)
+            assert card["objectives"][0]["pass"], stat
+
+    def test_evidence_dotted_path(self):
+        card = slo.evaluate(
+            _spec({"name": "dropped", "op": "==", "threshold": 0,
+                   "source": {"evidence": "loadgen.dropped"}}),
+            {}, evidence={"loadgen": {"dropped": 0, "ok": 7}})
+        obj = card["objectives"][0]
+        assert obj["pass"] and obj["observed"] == 0
+        assert "loadgen.dropped" in obj["evidence"]
+
+    def test_ratio(self):
+        card = slo.evaluate(
+            _spec({"name": "availability", "op": ">=", "threshold": 0.85,
+                   "source": {"ratio": [
+                       {"metric": "dmlc_requests_total",
+                        "labels": {"code": "200"}},
+                       {"metric": "dmlc_requests_total"}]}}),
+            _snapshot())
+        obj = card["objectives"][0]
+        assert obj["observed"] == pytest.approx(0.9) and obj["pass"]
+
+
+class TestFailureSemantics:
+    def test_absent_counter_reads_zero(self):
+        card = slo.evaluate(_spec(
+            {"name": "none_dropped", "op": "==", "threshold": 0,
+             "source": {"metric": "dmlc_never_declared_total"}}),
+            _snapshot())
+        obj = card["objectives"][0]
+        assert obj["observed"] == 0 and obj["pass"]
+
+    def test_absent_quantile_fails_not_passes(self):
+        card = slo.evaluate(_spec(
+            {"name": "latency", "op": "<=", "threshold": 1e9,
+             "source": {"metric": "dmlc_never_declared_seconds",
+                        "stat": "p99"}}), _snapshot())
+        obj = card["objectives"][0]
+        assert obj["observed"] is None and not obj["pass"]
+        assert not card["pass"]
+
+    def test_absent_evidence_fails(self):
+        card = slo.evaluate(
+            _spec({"name": "x", "op": "==", "threshold": 0,
+                   "source": {"evidence": "missing.path"}}),
+            {}, evidence={"present": 1})
+        assert not card["objectives"][0]["pass"]
+
+    def test_zero_denominator_ratio_fails(self):
+        card = slo.evaluate(
+            _spec({"name": "x", "op": ">=", "threshold": 0,
+                   "source": {"ratio": [
+                       {"evidence": "a"}, {"evidence": "b"}]}}),
+            {}, evidence={"a": 1, "b": 0})
+        assert not card["objectives"][0]["pass"]
+
+    def test_one_failed_objective_fails_the_card(self):
+        card = slo.evaluate(_spec(
+            {"name": "good", "op": ">=", "threshold": 1,
+             "source": {"metric": "dmlc_requests_total"}},
+            {"name": "bad", "op": "<=", "threshold": 5,
+             "source": {"metric": "dmlc_requests_total"}}), _snapshot())
+        assert [o["pass"] for o in card["objectives"]] == [True, False]
+        assert not card["pass"]
+        assert card["spec"] == "t"
+
+
+class TestCommittedSpecs:
+    """The specs the drills gate on must always validate."""
+
+    @pytest.mark.parametrize("name", ["fleet.json", "ps.json"])
+    def test_committed_spec_validates(self, name):
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "slo", name)
+        spec = slo.SLOSpec.load(path)
+        assert spec.objectives

@@ -1,0 +1,208 @@
+"""Distributed trace context (base/tracectx) + shard merge contracts.
+
+The propagation layer has to be trustworthy at its edges: wire encoding
+round-trips, hostile headers degrade to None, the ``DMLC_TRACE=0``
+discipline holds (span yields None, no tracer writes), children inherit
+their parent's trace id but mint fresh span ids, and the
+``DMLC_TRACE_CTX`` env overlay makes a launched process join its
+launcher's trace.  The trace_collect half runs against hand-built
+shards with known epochs so the cross-clock normalization is asserted
+numerically.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from dmlc_core_tpu.base import tracectx
+from dmlc_core_tpu.utils.profiler import (Tracer, global_tracer,
+                                          set_tracing, tracing_enabled)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+import trace_collect  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation(monkeypatch):
+    """Tracing off by default, no ambient env context, thread-local
+    state cleared, and the global tracer's buffer drained afterwards."""
+    monkeypatch.delenv(tracectx.ENV_KEY, raising=False)
+    was = tracing_enabled()
+    if hasattr(tracectx._tls, "ctx"):
+        del tracectx._tls.ctx
+    yield
+    set_tracing(was)
+    if hasattr(tracectx._tls, "ctx"):
+        del tracectx._tls.ctx
+    global_tracer().clear()
+
+
+class TestEncoding:
+    def test_roundtrip(self):
+        ctx = tracectx.TraceContext("ab" * 16, "cd" * 8)
+        assert tracectx.decode(ctx.encode()) == ctx
+
+    @pytest.mark.parametrize("bad", [
+        "", "garbage", "00-short-cd" * 3,
+        "00-" + "g" * 32 + "-" + "c" * 16 + "-01",       # non-hex
+        "00-" + "a" * 31 + "-" + "c" * 16 + "-01",       # short trace id
+        "00-" + "a" * 32 + "-" + "c" * 15 + "-01",       # short span id
+        "00-" + "a" * 32 + "-" + "c" * 16,               # missing flags
+    ])
+    def test_garbage_decodes_to_none(self, bad):
+        assert tracectx.decode(bad) is None
+
+    def test_decode_normalizes_case_and_whitespace(self):
+        enc = " 00-" + "AB" * 16 + "-" + "CD" * 8 + "-01 "
+        ctx = tracectx.decode(enc)
+        assert ctx == tracectx.TraceContext("ab" * 16, "cd" * 8)
+
+
+class TestDisabledDiscipline:
+    def test_span_yields_none_and_writes_nothing(self):
+        set_tracing(False)
+        before = len(global_tracer().events())
+        with tracectx.span("op") as ctx:
+            assert ctx is None
+        assert tracectx.current() is None
+        assert tracectx.current_header() is None
+        assert len(global_tracer().events()) == before
+
+    def test_attach_yields_none_when_off(self):
+        set_tracing(False)
+        enc = tracectx.TraceContext("ab" * 16, "cd" * 8).encode()
+        with tracectx.attach(enc) as ctx:
+            assert ctx is None
+
+
+class TestSpanParenting:
+    def test_edge_span_mints_fresh_trace(self):
+        set_tracing(True)
+        with tracectx.span("edge") as ctx:
+            assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+        assert tracectx.current() is None   # restored after the block
+
+    def test_child_inherits_trace_id_not_span_id(self):
+        set_tracing(True)
+        with tracectx.span("parent") as parent:
+            with tracectx.span("child") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.span_id != parent.span_id
+            assert tracectx.current() == parent
+
+    def test_span_events_carry_trace_span_parent_args(self):
+        set_tracing(True)
+        with tracectx.span("outer") as outer:
+            with tracectx.span("inner"):
+                pass
+        by_name = {e["name"]: e for e in global_tracer().events()}
+        assert by_name["outer"]["args"]["parent"] == ""
+        assert by_name["inner"]["args"]["parent"] == outer.span_id
+        assert (by_name["inner"]["args"]["trace"]
+                == by_name["outer"]["args"]["trace"] == outer.trace_id)
+
+    def test_attach_adopts_and_restores(self):
+        set_tracing(True)
+        inbound = tracectx.TraceContext("ab" * 16, "cd" * 8)
+        with tracectx.attach(inbound.encode()) as got:
+            assert got == inbound
+            with tracectx.span("handler") as ctx:
+                assert ctx.trace_id == inbound.trace_id
+        assert tracectx.current() is None
+
+    def test_attach_malformed_changes_nothing(self):
+        set_tracing(True)
+        with tracectx.span("outer") as outer:
+            with tracectx.attach("not-a-context"):
+                assert tracectx.current() == outer
+
+    def test_env_overlay_adopted_per_thread(self, monkeypatch):
+        set_tracing(True)
+        inbound = tracectx.TraceContext("ab" * 16, "cd" * 8)
+        monkeypatch.setenv(tracectx.ENV_KEY, inbound.encode())
+        seen = {}
+
+        def child():
+            seen["ctx"] = tracectx.current()
+            with tracectx.span("work") as ctx:
+                seen["span"] = ctx
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        assert seen["ctx"] == inbound
+        assert seen["span"].trace_id == inbound.trace_id
+
+
+class TestTracerMetadata:
+    def test_save_emits_process_metadata_and_epoch(self, tmp_path):
+        tracer = Tracer()
+        tracer.set_meta(role="replica", rank=3)
+        with tracer.scope("op", trace="t" * 32):
+            pass
+        path = tracer.save(str(tmp_path / "shard.json"))
+        doc = json.load(open(path))
+        meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        names = {e["name"] for e in meta}
+        assert {"process_name", "thread_name"} <= names
+        proc = next(e for e in meta if e["name"] == "process_name")
+        assert "replica" in proc["args"]["name"]
+        other = doc["otherData"]
+        assert other["role"] == "replica" and other["rank"] == 3
+        assert other["pid"] == os.getpid()
+        assert other["epoch_us"] > 0
+
+
+def _shard(path, pid, role, epoch_us, events):
+    doc = {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": role}},
+            *events,
+        ],
+        "otherData": {"epoch_us": epoch_us, "pid": pid, "role": role,
+                      "rank": 0, "dropped_events": 0},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+class TestTraceCollect:
+    def test_epoch_normalization_and_summary(self, tmp_path):
+        tid = "ab" * 16
+        # shard A started 2.5 s (wall) before shard B; both events sit
+        # at local ts=100us, so B's must land 2.5e6 us after A's
+        _shard(tmp_path / "trace-router-0-11.json", 11, "router",
+               1_000_000.0,
+               [{"name": "fleet.route", "ph": "X", "ts": 100.0,
+                 "dur": 50.0, "pid": 11, "tid": 1,
+                 "args": {"trace": tid, "span": "aa" * 8}}])
+        _shard(tmp_path / "trace-replica-0-22.json", 22, "replica",
+               3_500_000.0,
+               [{"name": "http./predict", "ph": "X", "ts": 100.0,
+                 "dur": 20.0, "pid": 22, "tid": 1,
+                 "args": {"trace": tid, "span": "bb" * 8}}])
+        out = tmp_path / "merged.json"
+        merged, summary = trace_collect.collect(str(tmp_path), str(out))
+        ts = {e["name"]: e["ts"] for e in merged["traceEvents"]
+              if e.get("ph") == "X"}
+        assert ts["fleet.route"] == 100.0
+        assert ts["http./predict"] == 100.0 + 2_500_000.0
+        assert summary["processes"] == 2
+        assert summary["events"] == 2
+        trace = summary["traces"][tid]
+        assert trace["pids"] == [11, 22]
+        assert trace["roles"] == ["replica", "router"]
+        assert set(trace["spans"]) == {"fleet.route", "http./predict"}
+        # the written artifact is the same doc, loadable Perfetto JSON
+        assert json.load(open(out))["traceEvents"]
+
+    def test_unparseable_shard_skipped(self, tmp_path):
+        (tmp_path / "trace-bad-0-1.json").write_text("{torn")
+        _shard(tmp_path / "trace-ok-0-2.json", 2, "ok", 0.0, [])
+        _, summary = trace_collect.collect(str(tmp_path))
+        assert summary["processes"] == 1
