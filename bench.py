@@ -965,6 +965,125 @@ def _fleet_bench() -> None:
         tracker.stop()
 
 
+def _tenants_emit(rec, final=False):
+    rec = {"metric": "tenant_requests_per_sec", "unit": "req/s",
+           "provisional": not final, **rec}
+    if final:
+        _attach_metrics(rec)
+        _attach_slo(rec)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(rec) + "\n")
+        sys.stdout.flush()
+
+
+def _tenants_bench() -> None:
+    """``--tenants``: multi-tenant registry under a Zipf tenant mix.
+
+    Publishes ``TENANTS_N`` distinct HistGBT models into one
+    :class:`TenantRegistry` capped at ``TENANTS_RESIDENT_CAP`` resident
+    runners, then drives it closed-loop from ``TENANTS_THREADS`` threads
+    sampling tenants from the same bounded-Zipf law the tenancy drill
+    uses — the hot head stays warm, the long tail churns through
+    eviction and compile-cache-backed warm restore.  Every response is
+    verified bit-exactly against the publishing model, so ``wrong`` is
+    paging-correctness evidence, not just a counter; the final line
+    carries per-tenant p50/p99 plus the eviction/restore totals the
+    scorecard gates."""
+    t0 = time.time()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
+    n_tenants = int(os.environ.get("TENANTS_N", 12))
+    cap = int(os.environ.get("TENANTS_RESIDENT_CAP", 4))
+    duration = min(float(os.environ.get("TENANTS_SECONDS", 6)),
+                   max(budget - 120, 2.0))
+    n_threads = int(os.environ.get("TENANTS_THREADS", 4))
+    zipf_a = float(os.environ.get("TENANTS_ZIPF_A", 1.1))
+    train_rows = int(os.environ.get("TENANTS_TRAIN_ROWS", 4000))
+    serve_rows = int(os.environ.get("TENANTS_SERVE_ROWS", 256))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dmlc_core_tpu.utils import force_cpu_devices
+        force_cpu_devices(int(os.environ["BENCH_FORCE_CPU"]))
+
+    cfg = {"tenants": n_tenants, "resident_cap": cap, "zipf_a": zipf_a,
+           "duration_s": duration, "threads": n_threads}
+    _tenants_emit({"value": 0.0, "phase": "train", **cfg})
+
+    import jax
+
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.serve.fleet.loadgen import (sample_tenant,
+                                                   zipf_weights)
+    from dmlc_core_tpu.serve.tenancy import TenantRegistry
+
+    rng = np.random.default_rng(17)
+    Xt = rng.normal(size=(train_rows, feats)).astype(np.float32)
+    X = Xt[:serve_rows]
+    reg = TenantRegistry(resident_cap=cap, max_batch=64)
+    names = [f"t{i:02d}" for i in range(n_tenants)]
+    expected = {}
+    for i, name in enumerate(names):
+        yt = (Xt[:, i % feats] + 0.5 * Xt[:, (i + 1) % feats]
+              > 0).astype(np.float32)
+        m = HistGBT(n_trees=3 + i % 3, max_depth=3, n_bins=32).fit(Xt, yt)
+        reg.publish(name, m)
+        # HistGBT is bit-exact across batch shapes, so any prefix of
+        # this full-batch oracle is THE expected answer for a request
+        expected[name] = np.asarray(m.predict(X))
+
+    cum = zipf_weights(n_tenants, zipf_a)
+    lat = {name: [] for name in names}   # list.append is GIL-atomic
+    wrongs = [0] * n_threads
+    stop = threading.Event()
+
+    def worker(idx):
+        r = np.random.default_rng(1000 + idx)
+        while not stop.is_set():
+            tenant = sample_tenant(r, names, cum)
+            n = int(r.integers(1, serve_rows + 1))
+            t1 = time.perf_counter()
+            _, runner = reg.current(tenant)
+            out = np.asarray(runner.predict(X[:n]))
+            lat[tenant].append(time.perf_counter() - t1)
+            if not np.array_equal(out, expected[tenant][:n]):
+                wrongs[idx] += 1
+
+    _tenants_emit({"value": 0.0, "phase": "load", **cfg})
+    workers = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    t_load = time.perf_counter()
+    for w in workers:
+        w.start()
+    time.sleep(duration)
+    stop.set()
+    for w in workers:
+        w.join(timeout=60)
+    wall = time.perf_counter() - t_load
+
+    count = sum(len(v) for v in lat.values())
+    by_tenant = {}
+    for name in names:
+        ms = np.sort(np.asarray(lat[name], dtype=np.float64)) * 1000.0
+        by_tenant[name] = {"count": int(ms.size)}
+        if ms.size:
+            by_tenant[name].update(
+                p50_ms=round(float(np.percentile(ms, 50)), 3),
+                p99_ms=round(float(np.percentile(ms, 99)), 3))
+    _tenants_emit({
+        "value": round(count / max(wall, 1e-9), 2),
+        "phase": "done",
+        "elapsed_s": round(time.time() - t0, 1),
+        "platform": jax.devices()[0].platform,
+        "requests": count,
+        "wrong": sum(wrongs),
+        "evictions": reg.evictions,
+        "warm_restores": reg.restores,
+        "resident": reg.resident(),
+        "by_tenant": by_tenant,
+        **cfg,
+    }, final=True)
+
+
 def _stream_emit(rec, final=False):
     rec = {"metric": "stream_staleness_seconds", "unit": "s",
            "provisional": not final, **rec}
@@ -1281,6 +1400,17 @@ def main() -> None:
     depth = int(os.environ.get("BENCH_DEPTH", 6))
     n_bins = int(os.environ.get("BENCH_BINS", 256))
 
+    # PR 12 levers are ON in the flagship config (BENCH_r06+): int4 bin
+    # packing, exclusive-feature bundling, and loss-guide growth at half
+    # the depth-wise build budget (16 expansions vs 2^(depth-1)=32
+    # builds at depth 6).  setdefault so an operator can still A/B any
+    # lever off (DMLC_BIN_PACK=0 etc.); the exact setting ships in the
+    # record's config.levers block either way.
+    os.environ.setdefault("DMLC_BIN_PACK", "1")
+    os.environ.setdefault("DMLC_FEATURE_BUNDLE", "1")
+    os.environ.setdefault("DMLC_GROW_POLICY", "lossguide")
+    os.environ.setdefault("DMLC_MAX_LEAVES", str(max(1 << (depth - 2), 4)))
+
     if os.environ.get("BENCH_FORCE_CPU"):
         # self-test hook: the axon TPU plugin overrides JAX_PLATFORMS,
         # so tests must pin CPU through the supported entry point
@@ -1328,7 +1458,15 @@ def main() -> None:
 
     rows, feats, rounds = _pick_config(deadline - time.time())
     EV["config"] = {"rows": rows, "features": feats, "rounds": rounds,
-                    "max_depth": depth, "n_bins": n_bins}
+                    "max_depth": depth, "n_bins": n_bins,
+                    "levers": {
+                        "bin_pack": os.environ["DMLC_BIN_PACK"] == "1",
+                        "feature_bundle":
+                            os.environ["DMLC_FEATURE_BUNDLE"] == "1",
+                        "grow_policy": os.environ["DMLC_GROW_POLICY"],
+                        "max_leaves":
+                            int(os.environ["DMLC_MAX_LEAVES"] or 0),
+                    }}
 
     # chips=N mode (ISSUE 7): BENCH_CHIPS pins the data-mesh width (0 /
     # unset = every local device — 1 chip on a single-chip host, 8 on a
@@ -1611,6 +1749,8 @@ if __name__ == "__main__":
         _serve_bench()
     elif "--fleet" in sys.argv:
         _fleet_bench()
+    elif "--tenants" in sys.argv:
+        _tenants_bench()
     elif "--stream" in sys.argv:
         _stream_bench()
     elif "--ps" in sys.argv:

@@ -306,6 +306,37 @@ declare("DMLC_FLEET_MIN_REPLICAS", 1,
 declare("DMLC_FLEET_MAX_REPLICAS", 8,
         "Autoscale ceiling on replica count.", "fleet")
 
+# -- multi-tenant serving ----------------------------------------------------
+declare("DMLC_TENANT_RESIDENT_CAP", 0,
+        "Maximum tenant models kept warm (runner resident) per replica; "
+        "beyond it the least-recently-served tenant is paged out to its "
+        "retained checkpoint bytes and warm-restored on next use. "
+        "0 = unlimited (no paging).", "tenancy")
+declare("DMLC_TENANT_CLASSES", "",
+        "Tenant SLO class map, e.g. 'gold:acme,bar;bronze:baz' — "
+        "semicolon-separated class:tenant,... groups.  Unlisted tenants "
+        "get DMLC_TENANT_DEFAULT_CLASS.", "tenancy")
+declare("DMLC_TENANT_DEFAULT_CLASS", "silver",
+        "SLO class assumed for tenants absent from "
+        "DMLC_TENANT_CLASSES (gold|silver|bronze).", "tenancy")
+declare("DMLC_TENANT_QUOTA", 0,
+        "Per-tenant cap on concurrent in-flight predicts at the router; "
+        "beyond it THAT tenant is shed with 429 (no other tenant "
+        "notices).  0 = no per-tenant quota.", "tenancy")
+declare("DMLC_TENANT_MAX_INFLIGHT", 64,
+        "Router-wide cap on concurrent tenant-tagged predicts; the "
+        "overload axis tenant shedding is graded against (bronze shed "
+        "at DMLC_TENANT_SHED_FRACTION of it, everyone at it).", "tenancy")
+declare("DMLC_TENANT_SHED_FRACTION", 0.5,
+        "Fraction of DMLC_TENANT_MAX_INFLIGHT at which bronze tenants "
+        "start shedding with 429 — the 'bronze sheds before gold "
+        "queues' contract (doc/serving.md).", "tenancy")
+declare("DMLC_TENANT_HEDGE_MS", 0,
+        "Gold-tenant hedge delay in milliseconds: when > 0 and a second "
+        "ring candidate exists, a gold predict still in flight after "
+        "this long is raced against the next replica; first success "
+        "wins.  0 disables hedging.", "tenancy")
+
 # -- streaming / online learning --------------------------------------------
 declare("DMLC_STREAM_POLL_S", 0.05,
         "Tailer base poll interval in seconds; idle polls back off "

@@ -44,7 +44,7 @@ COMMANDS: Dict[str, FrozenSet[str]] = {
                        "payload"}),
     # -- fleet endpoint registry (serve/fleet/replica.py) ---------------
     "serve_register": frozenset({"cmd", "rank", "url"}),
-    "serve_report": frozenset({"cmd", "rank", "load"}),
+    "serve_report": frozenset({"cmd", "rank", "load", "tenants"}),
     # -- parameter-server wire (parallel/ps/) ---------------------------
     "ps_register": frozenset({"cmd", "host", "port", "server_id"}),
     "ps_servers": frozenset({"cmd"}),

@@ -207,12 +207,29 @@ class JobSet:
 
     # -- spawning --------------------------------------------------------
     def _place(self, rank: int) -> str:
-        hosts = [h for h in self._transport.hosts()
-                 if self._transport.host_alive(h)]
-        if not hosts:
+        """Slot-aware bin-packing over live hosts.  ``hosts()`` is the
+        slot-expanded host file (a host contributing k slots appears k
+        times); the winner is the live host with the most FREE slots —
+        declared slots minus the ranks currently placed on it — so a
+        4-slot host absorbs four ranks before a 1-slot host sees a
+        second, and a respawn after a host death lands on the survivor
+        with capacity instead of at ``rank % len(hosts)`` (which is
+        blind to both slot counts and occupancy)."""
+        slots: Dict[str, int] = {}
+        for h in self._transport.hosts():
+            if self._transport.host_alive(h):
+                slots[h] = slots.get(h, 0) + 1
+        if not slots:
             raise TransportError(
                 f"jobset {self.name}: no live hosts to place rank {rank}")
-        return hosts[rank % len(hosts)]
+        with self._lock:
+            for st in self._ranks.values():
+                if st.rank == rank or st.done or st.handle is None:
+                    continue
+                if st.handle.host in slots:
+                    slots[st.handle.host] -= 1
+        # most free slots wins; host-file order breaks ties
+        return max(slots, key=lambda h: slots[h])
 
     def _do_spawn(self, rank: int) -> bool:
         """Spawn one rank whose state is marked ``spawning`` (transport

@@ -35,17 +35,19 @@ Quick start (see ``examples/serve_gbt.py`` and ``doc/serving.md``)::
 from dmlc_core_tpu.serve.batcher import (BatcherClosedError,  # noqa: F401
                                          DynamicBatcher, QueueFullError)
 from dmlc_core_tpu.serve.client import ResilientClient  # noqa: F401
-from dmlc_core_tpu.serve.frontend import (HttpServer,  # noqa: F401
-                                          ServeFrontend)
+from dmlc_core_tpu.serve.frontend import (TENANT_HEADER,  # noqa: F401
+                                          HttpServer, ServeFrontend)
 from dmlc_core_tpu.serve.instruments import serve_metrics  # noqa: F401
 from dmlc_core_tpu.serve.registry import (ModelRegistry,  # noqa: F401
                                           checkpoint_model, clone_model,
-                                          load_model_checkpoint)
+                                          load_model_checkpoint,
+                                          model_from_bytes, model_to_bytes)
 from dmlc_core_tpu.serve.runner import ModelRunner  # noqa: F401
 
 __all__ = [
     "ModelRunner", "DynamicBatcher", "QueueFullError",
     "BatcherClosedError", "ModelRegistry", "checkpoint_model",
-    "clone_model", "load_model_checkpoint", "HttpServer",
-    "ServeFrontend", "ResilientClient", "serve_metrics",
+    "clone_model", "load_model_checkpoint", "model_to_bytes",
+    "model_from_bytes", "HttpServer", "ServeFrontend", "TENANT_HEADER",
+    "ResilientClient", "serve_metrics",
 ]

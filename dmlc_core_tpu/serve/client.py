@@ -124,7 +124,9 @@ class ResilientClient:
         return isinstance(e, _TRANSPORT)
 
     def _request(self, method: str, path: str, body: bytes = b"",
-                 op: str = "serve_request") -> Tuple[int, Dict[str, str], bytes]:
+                 op: str = "serve_request",
+                 headers: Optional[Dict[str, str]] = None
+                 ) -> Tuple[int, Dict[str, str], bytes]:
         def attempt() -> Tuple[int, Dict[str, str], bytes]:
             # skip past endpoints whose breaker is open (bounded scan:
             # one pass over the ring; all-open falls through to the
@@ -147,6 +149,8 @@ class ResilientClient:
                 with _tracectx.span(f"client.{op}", endpoint=ep) as ctx:
                     hdrs = ({"Content-Type": "application/json"}
                             if body else {})
+                    if headers:
+                        hdrs.update(headers)
                     if ctx is not None:
                         hdrs[_tracectx.HTTP_HEADER] = ctx.encode()
                     out = http_request(
@@ -173,7 +177,8 @@ class ResilientClient:
 
     # -- API -------------------------------------------------------------
     def predict(self, rows: Any,
-                timeout_ms: Optional[int] = None
+                timeout_ms: Optional[int] = None,
+                tenant: Optional[str] = None
                 ) -> Tuple[np.ndarray, int]:
         """Score ``[k, F]`` rows (or one ``[F]`` row) →
         ``(predictions, model_version)``.
@@ -181,14 +186,22 @@ class ResilientClient:
         ``timeout_ms`` rides in the request body as the end-to-end
         deadline the frontend enforces: a request that would expire in
         the batch queue is shed server-side (504 → retried here while
-        budget remains, then raised)."""
+        budget remains, then raised).
+
+        ``tenant`` adds the ``X-Dmlc-Tenant`` header, so the rows
+        resolve against that tenant's namespace (router admission +
+        replica tenant registry — doc/serving.md, multi-tenant)."""
         rows = np.asarray(rows, np.float32)
         payload: Dict[str, Any] = {"rows": rows.tolist()}
         if timeout_ms is not None:
             payload["timeout_ms"] = int(timeout_ms)
+        extra = None
+        if tenant is not None:
+            from dmlc_core_tpu.serve.frontend import TENANT_HEADER
+            extra = {TENANT_HEADER: tenant}
         _, _, body = self._request(
             "POST", "/predict", json.dumps(payload).encode(),
-            op="serve_predict")
+            op="serve_predict", headers=extra)
         doc = json.loads(body)
         return (np.asarray(doc["predictions"], np.float32),
                 int(doc["version"]))

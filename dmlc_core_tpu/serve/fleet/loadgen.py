@@ -29,6 +29,21 @@ returns fleet p50/p95/p99, per-version counts, and the drop/wrong
 totals that the hot-swap acceptance gate (``dropped==0 and wrong==0``)
 reads.  The expected predictions ride an ``.npz``: array ``X`` plus
 one array ``v{version}`` per version the fleet may answer with.
+
+**Multi-tenant mode** (``tenants=[...]``): each request first draws a
+tenant from a bounded Zipf — P(tenant i) ∝ 1/(i+1)^``zipf_a`` over the
+configured order, so the first tenant is hot and the tail is long, the
+skew real multi-model fleets exhibit — and rides the
+``X-Dmlc-Tenant`` header.  Expected arrays are then keyed
+``{tenant}__v{version}``, reports gain a fourth bucket:
+
+* ``shed`` — the router *deliberately* refused after the whole retry
+  budget (terminal 429 quota/class shed or 503 saturation).  Admission
+  control doing its job is not a drop; the tenancy drill gates the two
+  buckets separately (bronze may shed, nobody may drop).
+
+and the merged summary carries per-tenant counts and p50/95/99 so the
+SLO scorecard can gate *each tenant's* tail latency, not the blend.
 """
 
 from __future__ import annotations
@@ -45,7 +60,8 @@ import numpy as np
 
 from dmlc_core_tpu.base.logging import CHECK
 
-__all__ = ["sample_size", "diurnal_qps", "run_loadgen", "loadgen_worker"]
+__all__ = ["sample_size", "diurnal_qps", "zipf_weights", "sample_tenant",
+           "run_loadgen", "loadgen_worker"]
 
 
 def sample_size(rng: np.random.Generator, alpha: float = 1.5,
@@ -65,13 +81,32 @@ def diurnal_qps(t_s: float, base_qps: float, amplitude: float = 0.5,
     return max(0.1 * base_qps, qps)
 
 
+def zipf_weights(n: int, a: float = 1.1) -> np.ndarray:
+    """Cumulative bounded-Zipf weights over ``n`` ranks:
+    P(i) ∝ 1/(i+1)^``a``.  Pure; feed to :func:`sample_tenant`."""
+    CHECK(n >= 1, f"zipf over empty support (n={n})")
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), a)
+    return np.cumsum(w / w.sum())
+
+
+def sample_tenant(rng: np.random.Generator, tenants: Sequence[str],
+                  cum: np.ndarray) -> str:
+    """Draw one tenant under the cumulative weights from
+    :func:`zipf_weights` (index 0 = hottest)."""
+    return tenants[int(np.searchsorted(cum, rng.random()))]
+
+
 def _client_thread(cfg: Dict[str, Any], X: np.ndarray,
-                   expected: Dict[int, np.ndarray], seed: int,
+                   expected: Dict[Any, np.ndarray], seed: int,
                    out: List[Any]) -> None:
+    from dmlc_core_tpu.io.http_util import HttpError
     from dmlc_core_tpu.serve.client import ResilientClient
 
     client = ResilientClient(cfg["endpoints"])
     rng = np.random.default_rng(seed)
+    tenants = list(cfg.get("tenants") or [])
+    cum = zipf_weights(len(tenants), cfg.get("zipf_a", 1.1)) \
+        if tenants else None
     per_thread_qps = cfg["base_qps"] / (cfg["procs"] * cfg["threads"])
     t_start = time.monotonic()
     next_t = t_start
@@ -79,21 +114,30 @@ def _client_thread(cfg: Dict[str, Any], X: np.ndarray,
         now = time.monotonic()
         if now - t_start >= cfg["duration_s"]:
             return
+        tenant = sample_tenant(rng, tenants, cum) if tenants else None
         k = sample_size(rng, cfg["alpha"], cfg["max_size"])
         lo = int(rng.integers(0, len(X) - k + 1))
         t0 = time.monotonic()
         try:
             preds, version = client.predict(
-                X[lo:lo + k], timeout_ms=cfg["timeout_ms"])
+                X[lo:lo + k], timeout_ms=cfg["timeout_ms"],
+                tenant=tenant)
             lat = time.monotonic() - t0
-            want = expected.get(int(version))
+            want = expected.get((tenant, int(version)))
             if want is not None and np.array_equal(
                     preds, want[lo:lo + k]):
-                out.append(("ok", int(version), lat))
+                out.append(("ok", int(version), lat, tenant))
             else:
-                out.append(("wrong", int(version), lat))
+                out.append(("wrong", int(version), lat, tenant))
+        except HttpError as e:  # noqa: PERF203 — terminal status
+            lat = time.monotonic() - t0
+            # a DELIBERATE refusal (quota/class 429, saturation 503)
+            # that outlived the retry budget is admission control, not
+            # data loss — the drill gates the buckets separately
+            status = "shed" if e.status in (429, 503) else "dropped"
+            out.append((status, -1, lat, tenant))
         except Exception:  # noqa: BLE001 — retry budget exhausted
-            out.append(("dropped", -1, time.monotonic() - t0))
+            out.append(("dropped", -1, time.monotonic() - t0, tenant))
         # closed-loop pacing against the diurnal ramp: never issue
         # before the previous answer, sleep off any surplus
         rate = diurnal_qps(now - t_start, per_thread_qps,
@@ -115,8 +159,14 @@ def loadgen_worker(cfg_path: str) -> int:
     _agg.install_spool("loadgen", int(cfg.get("seed", 0)))
     data = np.load(cfg["expected_npz"])
     X = np.asarray(data["X"], np.float32)
-    expected = {int(k[1:]): np.asarray(data[k], np.float32)
-                for k in data.files if k.startswith("v")}
+    # "v{n}" = untenanted; "{tenant}__v{n}" = that tenant's version n
+    expected: Dict[Any, np.ndarray] = {}
+    for k in data.files:
+        if "__v" in k:
+            tenant, _, ver = k.rpartition("__v")
+            expected[(tenant, int(ver))] = np.asarray(data[k], np.float32)
+        elif k.startswith("v"):
+            expected[(None, int(k[1:]))] = np.asarray(data[k], np.float32)
     out: List[Any] = []
     threads = [threading.Thread(
         target=_client_thread,
@@ -126,19 +176,29 @@ def loadgen_worker(cfg_path: str) -> int:
         t.start()
     for t in threads:
         t.join(timeout=cfg["duration_s"] + 60)
-    report = {
+    report: Dict[str, Any] = {
         "count": len(out),
-        "ok": sum(1 for s, _, _ in out if s == "ok"),
-        "dropped": sum(1 for s, _, _ in out if s == "dropped"),
-        "wrong": sum(1 for s, _, _ in out if s == "wrong"),
+        "ok": sum(1 for s, _, _, _ in out if s == "ok"),
+        "dropped": sum(1 for s, _, _, _ in out if s == "dropped"),
+        "wrong": sum(1 for s, _, _, _ in out if s == "wrong"),
+        "shed": sum(1 for s, _, _, _ in out if s == "shed"),
         "by_version": {},
-        "lats_ms": [round(lat * 1000.0, 3) for s, _, lat in out
+        "by_tenant": {},
+        "lats_ms": [round(lat * 1000.0, 3) for s, _, lat, _ in out
                     if s == "ok"],
     }
-    for s, v, _ in out:
+    for s, v, lat, tenant in out:
         if s == "ok":
             key = str(v)
             report["by_version"][key] = report["by_version"].get(key, 0) + 1
+        if tenant is not None:
+            t_rep = report["by_tenant"].setdefault(
+                tenant, {"count": 0, "ok": 0, "dropped": 0, "wrong": 0,
+                         "shed": 0, "lats_ms": []})
+            t_rep["count"] += 1
+            t_rep[s] += 1
+            if s == "ok":
+                t_rep["lats_ms"].append(round(lat * 1000.0, 3))
     with open(cfg["out"], "w") as f:
         json.dump(report, f)
     return 0
@@ -150,11 +210,19 @@ def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
                 period_s: float = 10.0, alpha: float = 1.5,
                 max_size: int = 32, timeout_ms: int = 2000,
                 seed: int = 0, workdir: Optional[str] = None,
-                env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+                env: Optional[Dict[str, str]] = None,
+                tenants: Optional[Sequence[str]] = None,
+                zipf_a: float = 1.1) -> Dict[str, Any]:
     """Fan out ``procs`` worker processes against ``endpoints`` (one
     router URL or a replica URL list) and merge their reports into the
-    fleet summary: ``{count, ok, dropped, wrong, by_version,
-    latency_p50/95/99_ms, throughput_rps}``."""
+    fleet summary: ``{count, ok, dropped, wrong, shed, by_version,
+    latency_p50/95/99_ms, throughput_rps}``.
+
+    ``tenants`` switches on multi-tenant mode: requests draw a tenant
+    from a bounded Zipf(``zipf_a``) over the given order (first =
+    hottest) and the summary gains ``by_tenant`` — per-tenant
+    count/ok/dropped/wrong/shed plus p50/95/99 — so a drill can gate
+    each tenant's tail, not the blend."""
     CHECK(procs >= 1 and threads >= 1,
           f"need >=1 procs/threads, got {procs}/{threads}")
     import tempfile
@@ -179,6 +247,8 @@ def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
                "amplitude": amplitude, "period_s": period_s,
                "alpha": alpha, "max_size": max_size,
                "timeout_ms": timeout_ms, "seed": seed + p,
+               "tenants": list(tenants) if tenants else None,
+               "zipf_a": zipf_a,
                "out": os.path.join(workdir, f"loadgen_{p}.json")}
         cfg_path = os.path.join(workdir, f"loadgen_{p}.cfg.json")
         with open(cfg_path, "w") as f:
@@ -187,18 +257,27 @@ def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
             [sys.executable, "-m", "dmlc_core_tpu.serve.fleet.loadgen",
              "--worker", cfg_path], env=child_env)))
     merged: Dict[str, Any] = {"count": 0, "ok": 0, "dropped": 0,
-                              "wrong": 0, "by_version": {}}
+                              "wrong": 0, "shed": 0, "by_version": {},
+                              "by_tenant": {}}
     lats: List[float] = []
+    tenant_lats: Dict[str, List[float]] = {}
     try:
         for cfg, proc in children:
             rc = proc.wait(timeout=duration_s + 120)
             CHECK(rc == 0, f"loadgen worker exited rc={rc}")
             with open(cfg["out"]) as f:
                 rep = json.load(f)
-            for k in ("count", "ok", "dropped", "wrong"):
+            for k in ("count", "ok", "dropped", "wrong", "shed"):
                 merged[k] += rep[k]
             for v, n in rep["by_version"].items():
                 merged["by_version"][v] = merged["by_version"].get(v, 0) + n
+            for tenant, t_rep in rep.get("by_tenant", {}).items():
+                m = merged["by_tenant"].setdefault(
+                    tenant, {"count": 0, "ok": 0, "dropped": 0,
+                             "wrong": 0, "shed": 0})
+                for k in ("count", "ok", "dropped", "wrong", "shed"):
+                    m[k] += t_rep[k]
+                tenant_lats.setdefault(tenant, []).extend(t_rep["lats_ms"])
             lats.extend(rep["lats_ms"])
     finally:
         # a mid-loop CHECK failure must not strand the remaining workers
@@ -217,6 +296,12 @@ def run_loadgen(endpoints: Union[str, Sequence[str]], expected_npz: str,
                    (99, "latency_p99_ms")):
         merged[key] = (round(float(np.percentile(lats, q)), 3)
                        if lats else None)
+    for tenant, t_lats in tenant_lats.items():
+        for q, key in ((50, "latency_p50_ms"), (95, "latency_p95_ms"),
+                       (99, "latency_p99_ms")):
+            merged["by_tenant"][tenant][key] = (
+                round(float(np.percentile(t_lats, q)), 3)
+                if t_lats else None)
     return merged
 
 

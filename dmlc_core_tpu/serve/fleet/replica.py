@@ -97,7 +97,13 @@ class FleetTracker(RabitTracker):
             return {"ok": True}
         if cmd == "serve_report":
             with self._lock:
-                self._loads[int(msg["rank"])] = dict(msg.get("load") or {})
+                load = dict(msg.get("load") or {})
+                if "tenants" in msg:
+                    # tenancy-enabled replicas heartbeat their tenant
+                    # map (version + residency per tenant) so rollout
+                    # gates and autoscale can read it fleet-wide
+                    load["tenants"] = msg["tenants"]
+                self._loads[int(msg["rank"])] = load
             return {"ok": True}
         if cmd == "serve_endpoints":
             with self._lock:
@@ -190,6 +196,26 @@ class ReplicaFrontend(ServeFrontend):
             self.registry.activate(int(payload["version"]))
             return (200, {"active": self.registry.current_version()},
                     "application/json", {})
+        if path == "/admin/tenant/load":
+            if self.tenants is None:
+                return (400, {"error": "tenancy not enabled"},
+                        "application/json", {})
+            tenant = str(payload["tenant"])
+            version = self.tenants.load(
+                tenant, str(payload["uri"]),
+                activate=bool(payload.get("activate", True)))
+            return (200, {"version": version, "tenant": tenant,
+                          "active": self.tenants.current_version(tenant)},
+                    "application/json", {})
+        if path == "/admin/tenant/activate":
+            if self.tenants is None:
+                return (400, {"error": "tenancy not enabled"},
+                        "application/json", {})
+            tenant = str(payload["tenant"])
+            self.tenants.activate(tenant, int(payload["version"]))
+            return (200, {"tenant": tenant,
+                          "active": self.tenants.current_version(tenant)},
+                    "application/json", {})
         if path == "/admin/shutdown":
             self.drain()
             if self._on_shutdown is not None:
@@ -208,17 +234,22 @@ class Replica:
                  name: str = "fleet", host: str = "127.0.0.1",
                  port: int = 0, model_uri: Optional[str] = None,
                  max_batch: int = 64, max_delay: float = 0.002,
-                 max_queue: int = 256,
+                 max_queue: int = 256, tenancy: bool = False,
                  heartbeat_s: Optional[float] = None, **runner_opts: Any):
         self._stop = threading.Event()
         self.registry = ModelRegistry(name=name, max_batch=max_batch,
                                       **runner_opts)
         if model_uri:
             self.registry.load(model_uri)
+        self.tenants = None
+        if tenancy:
+            from dmlc_core_tpu.serve.tenancy import TenantRegistry
+            self.tenants = TenantRegistry(max_batch=max_batch,
+                                          **runner_opts)
         self.frontend = ReplicaFrontend(
             self.registry, on_shutdown=self._stop.set, host=host,
             port=port, max_batch=max_batch, max_delay=max_delay,
-            max_queue=max_queue)
+            max_queue=max_queue, tenants=self.tenants)
         self.frontend.start()
         # the persistent session IS the liveness contract: if this
         # process dies, the tracker sees the socket close and evicts us
@@ -247,9 +278,15 @@ class Replica:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._heartbeat_s):
             try:
-                self.session.request({"cmd": "serve_report",
-                                      "rank": self.rank,
-                                      "load": self.frontend.load_report()})
+                if self.tenants is not None:
+                    self.session.request({"cmd": "serve_report",
+                                          "rank": self.rank,
+                                          "load": self.frontend.load_report(),
+                                          "tenants": self.tenants.summary()})
+                else:
+                    self.session.request(
+                        {"cmd": "serve_report", "rank": self.rank,
+                         "load": self.frontend.load_report()})
             except Exception:  # noqa: BLE001 — tracker gone → stop beating
                 return
 
@@ -281,6 +318,7 @@ class Replica:
 def replica_env(tracker_uri: str, tracker_port: int,
                 model_uri: Optional[str] = None, name: str = "fleet",
                 max_batch: int = 64, max_queue: int = 256,
+                tenancy: bool = False,
                 extra_env: Optional[Dict[str, str]] = None
                 ) -> Dict[str, str]:
     """The ``FLEET_*`` env overlay a replica subprocess is spawned with
@@ -292,6 +330,8 @@ def replica_env(tracker_uri: str, tracker_port: int,
            "FLEET_MAX_QUEUE": str(max_queue)}
     if model_uri:
         env["FLEET_MODEL_URI"] = model_uri
+    if tenancy:
+        env["FLEET_TENANCY"] = "1"
     # `python -m dmlc_core_tpu...` resolves against the child's cwd,
     # not the parent's sys.path — pin the package root so supervised
     # replicas import regardless of where the caller was launched
@@ -314,6 +354,7 @@ _spawn_seq = 0
 def spawn_replica(tracker_uri: str, tracker_port: int,
                   model_uri: Optional[str] = None, name: str = "fleet",
                   max_batch: int = 64, max_queue: int = 256,
+                  tenancy: bool = False,
                   extra_env: Optional[Dict[str, str]] = None
                   ) -> "subprocess.Popen[bytes]":
     """Launch a replica as a child process (``python -m
@@ -339,7 +380,7 @@ def spawn_replica(tracker_uri: str, tracker_port: int,
         REPLICA_COMMAND,
         replica_env(tracker_uri, tracker_port, model_uri=model_uri,
                     name=name, max_batch=max_batch, max_queue=max_queue,
-                    extra_env=extra_env),
+                    tenancy=tenancy, extra_env=extra_env),
         _spawn_transport.hosts()[0], label=f"{name}-replica-{seq}")
     return handle.proc
 
@@ -357,7 +398,8 @@ def replica_main(argv: Optional[List[str]] = None) -> int:
         model_uri=os.environ.get("FLEET_MODEL_URI") or None,
         max_batch=int(os.environ.get("FLEET_MAX_BATCH", "64")),
         max_delay=float(os.environ.get("FLEET_MAX_DELAY", "0.002")),
-        max_queue=int(os.environ.get("FLEET_MAX_QUEUE", "256")))
+        max_queue=int(os.environ.get("FLEET_MAX_QUEUE", "256")),
+        tenancy=os.environ.get("FLEET_TENANCY", "") == "1")
     from dmlc_core_tpu.base import metrics_agg as _agg
     _agg.install_spool("replica", replica.rank)
     signal.signal(signal.SIGTERM, lambda *_: replica.stop())

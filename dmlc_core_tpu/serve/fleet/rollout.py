@@ -124,12 +124,16 @@ class FleetAdmin:
         """rank → addressable endpoint."""
         raise NotImplementedError
 
-    def load(self, rank: int, uri: str, activate: bool = False) -> int:
-        """Publish checkpoint ``uri`` on ``rank``; returns the version."""
+    def load(self, rank: int, uri: str, activate: bool = False,
+             tenant: Optional[str] = None) -> int:
+        """Publish checkpoint ``uri`` on ``rank`` (within ``tenant``'s
+        namespace when given); returns the version."""
         raise NotImplementedError
 
-    def activate(self, rank: int, version: int) -> None:
-        """Switch ``rank``'s traffic to a retained ``version``."""
+    def activate(self, rank: int, version: int,
+                 tenant: Optional[str] = None) -> None:
+        """Switch ``rank``'s traffic to a retained ``version`` (within
+        ``tenant``'s namespace when given)."""
         raise NotImplementedError
 
     def health(self, rank: int) -> Dict[str, Any]:
@@ -157,11 +161,22 @@ class HttpFleetAdmin(FleetAdmin):
     def replicas(self) -> Dict[int, str]:
         return dict(self._endpoints)
 
-    def load(self, rank: int, uri: str, activate: bool = False) -> int:
+    def load(self, rank: int, uri: str, activate: bool = False,
+             tenant: Optional[str] = None) -> int:
+        if tenant is not None:
+            return int(self._post(
+                rank, "/admin/tenant/load",
+                {"tenant": tenant, "uri": uri,
+                 "activate": activate})["version"])
         return int(self._post(rank, "/admin/load",
                               {"uri": uri, "activate": activate})["version"])
 
-    def activate(self, rank: int, version: int) -> None:
+    def activate(self, rank: int, version: int,
+                 tenant: Optional[str] = None) -> None:
+        if tenant is not None:
+            self._post(rank, "/admin/tenant/activate",
+                       {"tenant": tenant, "version": version})
+            return
         self._post(rank, "/admin/activate", {"version": version})
 
     def health(self, rank: int) -> Dict[str, Any]:
@@ -184,12 +199,34 @@ class Rollout:
     def __init__(self, admin: FleetAdmin,
                  wave_size: Optional[int] = None,
                  eval_gate: Optional[Callable[[int], bool]] = None,
-                 settle_s: float = 0.2):
+                 settle_s: float = 0.2, tenant: Optional[str] = None):
         self.admin = admin
         self.wave_size = (wave_size if wave_size is not None else
                           int(os.environ.get("DMLC_FLEET_WAVE_SIZE", "1")))
         self.eval_gate = eval_gate
         self.settle_s = settle_s
+        #: tenant-scoped rollout: stage/activate/gate/rollback all act
+        #: on ONE tenant's namespace — every other tenant's current
+        #: pointer is untouched by construction (doc/serving.md)
+        self.tenant = tenant
+
+    def _doc_version(self, doc: Dict[str, Any]) -> Optional[int]:
+        if self.tenant is None:
+            return doc.get("version")
+        return (doc.get("tenants") or {}).get(self.tenant,
+                                              {}).get("version")
+
+    def _load(self, rank: int, uri: str) -> int:
+        if self.tenant is None:
+            return self.admin.load(rank, uri, activate=False)
+        return self.admin.load(rank, uri, activate=False,
+                               tenant=self.tenant)
+
+    def _activate(self, rank: int, version: int) -> None:
+        if self.tenant is None:
+            self.admin.activate(rank, version)
+        else:
+            self.admin.activate(rank, version, tenant=self.tenant)
 
     def run(self, uri: str) -> Dict[str, Any]:
         """Deploy checkpoint ``uri`` fleet-wide; returns a report dict
@@ -198,14 +235,15 @@ class Rollout:
         ranks = sorted(endpoints)
         CHECK(ranks, "rollout over an empty fleet")
         old: Dict[int, Optional[int]] = {
-            r: self.admin.health(r).get("version") for r in ranks}
+            r: self._doc_version(self.admin.health(r)) for r in ranks}
         version = 0
         for r in ranks:                       # stage everywhere first
-            version = self.admin.load(r, uri, activate=False)
+            version = self._load(r, uri)
         if _metrics.enabled():
             fleet_metrics()["rollout_target"].set(version)
         LOG("INFO", "fleet.rollout: v%d staged on %d replicas "
-            "(wave size %d)", version, len(ranks), self.wave_size)
+            "(wave size %d)%s", version, len(ranks), self.wave_size,
+            f" for tenant {self.tenant!r}" if self.tenant else "")
         ctrl = RolloutController(ranks, self.wave_size)
         ctrl.staged()
         report: Dict[str, Any] = {"version": version, "replicas": ranks,
@@ -216,7 +254,7 @@ class Rollout:
                 report["outcome"] = "activated"
                 break
             for r in wave:
-                self.admin.activate(r, version)
+                self._activate(r, version)
             time.sleep(self.settle_s)
             ok = self._gate(wave, version)
             report["waves"].append({"replicas": wave, "ok": ok})
@@ -229,11 +267,16 @@ class Rollout:
             targets = ctrl.wave_failed()
             for r in targets:
                 if old[r] is not None:
-                    self.admin.activate(r, old[r])
+                    self._activate(r, old[r])
             report["outcome"] = "rolled_back"
             report["rolled_back"] = targets
+            if self.tenant is not None and _metrics.enabled():
+                from dmlc_core_tpu.serve.tenancy.instruments import \
+                    tenant_metrics
+                tenant_metrics()["rollbacks"].inc(1, tenant=self.tenant)
             LOG("WARNING", "fleet.rollout: v%d regressed — rolled %d "
-                "replicas back", version, len(targets))
+                "replicas back%s", version, len(targets),
+                f" for tenant {self.tenant!r}" if self.tenant else "")
             break
         return report
 
@@ -243,7 +286,9 @@ class Rollout:
                 doc = self.admin.health(r)
             except Exception:  # noqa: BLE001 — unreachable == regressed
                 return False
-            if doc.get("status") != "ok" or doc.get("version") != version:
+            if doc.get("status") != "ok":
+                return False
+            if self._doc_version(doc) != version:
                 return False
         if self.eval_gate is not None:
             try:

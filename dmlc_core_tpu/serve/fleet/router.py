@@ -47,10 +47,13 @@ from dmlc_core_tpu.base import tracectx as _tracectx
 from dmlc_core_tpu.base.logging import CHECK, LOG
 from dmlc_core_tpu.base.racecheck import instrument_class
 from dmlc_core_tpu.base.resilience import CircuitBreaker, RetryPolicy
+from dmlc_core_tpu.base.timer import get_time
 from dmlc_core_tpu.io.http_util import HttpError, http_request
 from dmlc_core_tpu.serve.fleet.instruments import fleet_metrics
 from dmlc_core_tpu.serve.fleet.replica import FleetTracker
-from dmlc_core_tpu.serve.frontend import HttpServer
+from dmlc_core_tpu.serve.frontend import TENANT_HEADER, HttpServer
+from dmlc_core_tpu.serve.tenancy.instruments import tenant_metrics
+from dmlc_core_tpu.serve.tenancy.policy import TenantPolicy
 
 __all__ = ["HashRing", "FleetRouter"]
 
@@ -145,7 +148,8 @@ class FleetRouter(HttpServer):
     def __init__(self, tracker: FleetTracker, host: str = "127.0.0.1",
                  port: int = 0, max_queue: Optional[int] = None,
                  probe_s: Optional[float] = None,
-                 failover: Optional[int] = None):
+                 failover: Optional[int] = None,
+                 policy: Optional[TenantPolicy] = None):
         super().__init__(host=host, port=port, name="fleet-router")
         self._tracker = tracker
         self.max_queue = (max_queue if max_queue is not None else
@@ -154,9 +158,15 @@ class FleetRouter(HttpServer):
                         float(os.environ.get("DMLC_FLEET_PROBE_S", "0.5")))
         self.failover = (failover if failover is not None else
                          int(os.environ.get("DMLC_FLEET_FAILOVER", "2")))
+        #: tenant admission policy (SLO classes, quotas, hedging) —
+        #: resolved from the DMLC_TENANT_* knobs unless injected
+        self.policy = policy if policy is not None else TenantPolicy()
         self._lock = threading.Lock()
         self._replicas: Dict[int, _ReplicaState] = {}
         self._ring = HashRing([])
+        self._tenant_inflight: Dict[str, int] = {}
+        self._tenant_inflight_total = 0
+        self._hedge_threads: List[threading.Thread] = []
         self._probe_thread = threading.Thread(
             target=self._probe_loop, daemon=True, name="fleet-probe")
 
@@ -173,6 +183,11 @@ class FleetRouter(HttpServer):
         super().close()          # sets _done → probe loop exits
         if self._probe_thread.is_alive():
             self._probe_thread.join(timeout=2.0)
+        with self._lock:
+            hedges = list(self._hedge_threads)
+            self._hedge_threads.clear()
+        for t in hedges:
+            t.join(timeout=2.0)
 
     # -- membership / health ---------------------------------------------
     def _probe_loop(self) -> None:
@@ -238,7 +253,8 @@ class FleetRouter(HttpServer):
         if path == "/predict":
             if method != "POST":
                 return 405, {"error": "POST only"}, "application/json", {}
-            return self._route_predict(body)
+            tenant = (headers or {}).get(TENANT_HEADER.lower())
+            return self._route_predict(body, tenant=tenant)
         if path == "/healthz":
             docs = self.replica_docs()
             healthy = sum(1 for d in docs.values() if d["healthy"])
@@ -252,22 +268,17 @@ class FleetRouter(HttpServer):
                     "text/plain; version=0.0.4; charset=utf-8", {})
         return super()._route(method, path, body, headers)
 
-    def _route_predict(self, body: bytes
+    def _route_predict(self, body: bytes, tenant: Optional[str] = None
                        ) -> Tuple[int, Any, str, Dict[str, str]]:
         with _tracectx.span("fleet.route"):
+            if tenant:
+                return self._route_tenant_predict(tenant, body)
             return self._route_predict_traced(body)
 
     def _route_predict_traced(self, body: bytes
                               ) -> Tuple[int, Any, str, Dict[str, str]]:
         m = fleet_metrics() if _metrics.enabled() else None
-        with self._lock:
-            routable = self._routable_locked()
-            ring = self._ring
-            depth = sum(self._replicas[r].queue_depth for r in routable)
-            candidates = [(r, self._replicas[r].url,
-                           self._replicas[r].breaker)
-                          for r in ring.sequence(body)
-                          if r in routable][:1 + self.failover]
+        candidates, depth = self._candidates_for(body)
         if not candidates:
             if m:
                 m["shed"].inc(1, reason="no_replicas")
@@ -279,49 +290,164 @@ class FleetRouter(HttpServer):
             return (503, {"error": f"fleet queue depth {depth} > "
                                    f"{self.max_queue}"},
                     "application/json", {"Retry-After": "1"})
-        last_shed: Optional[HttpError] = None
-        for rank, url, breaker in candidates:
-            if not breaker.allow():
-                if m:
-                    m["failover"].inc(1, reason="open")
-                continue
-            try:
-                with _tracectx.span("fleet.forward",
-                                    replica=str(rank)) as fwd:
-                    hdrs_out = {"Content-Type": "application/json"}
-                    if fwd is not None:
-                        hdrs_out[_tracectx.HTTP_HEADER] = fwd.encode()
-                    _, _, data = http_request(
-                        "POST", url + "/predict", hdrs_out, body,
-                        ok=(200,), retry=_ONE_ATTEMPT, idempotent=True,
-                        op="fleet_route")
-            except HttpError as e:
-                if e.status == 503:
-                    # alive-but-shedding: NOT a breaker failure (see
-                    # module docstring) — walk to the next replica
-                    breaker.record_success()
-                    last_shed = e
-                    if m:
-                        m["failover"].inc(1, reason="shed")
-                    continue
-                if 400 <= e.status < 500 and e.status not in (408, 429):
-                    # the request's own fault — identical everywhere,
-                    # pass the replica's verdict through
-                    return (e.status, e.body, "application/json", {})
-                breaker.record_failure()
-                if m:
-                    m["failover"].inc(1, reason="transport")
-                continue
-            except Exception:  # noqa: BLE001 — refused/reset/timeout
-                breaker.record_failure()
-                self._mark_unhealthy(rank)
-                if m:
-                    m["failover"].inc(1, reason="transport")
-                continue
-            breaker.record_success()
+        return self._walk(candidates, body)
+
+    # -- tenant-aware routing (doc/serving.md, "Multi-tenant serving") ---
+    def _route_tenant_predict(self, tenant: str, body: bytes
+                              ) -> Tuple[int, Any, str, Dict[str, str]]:
+        tm = tenant_metrics() if _metrics.enabled() else None
+        t0 = get_time()
+        out = self._admit_tenant(tenant, body)
+        if tm:
+            tm["requests"].inc(1, tenant=tenant, code=str(out[0]))
+            tm["e2e"].observe(get_time() - t0, tenant=tenant)
+        return out
+
+    def _admit_tenant(self, tenant: str, body: bytes
+                      ) -> Tuple[int, Any, str, Dict[str, str]]:
+        """Per-tenant admission: quota first (one hot tenant cannot
+        monopolize the fleet), then the class-graded in-flight ladder —
+        bronze sheds with 429 at ``shed_fraction * max_inflight`` while
+        gold/silver ride to the full cap (503 there: the FLEET is
+        saturated, not the tenant's class)."""
+        pol = self.policy
+        tm = tenant_metrics() if _metrics.enabled() else None
+        with self._lock:
+            mine = self._tenant_inflight.get(tenant, 0)
+            if pol.quota and mine >= pol.quota:
+                if tm:
+                    tm["shed"].inc(1, tenant=tenant, reason="quota")
+                return (429, {"error": f"tenant {tenant!r} over quota "
+                                       f"({mine} >= {pol.quota} in flight)"},
+                        "application/json", {"Retry-After": "1"})
+            total = self._tenant_inflight_total
+            if total >= pol.shed_threshold(tenant):
+                if pol.class_of(tenant) == "bronze" \
+                        and total < pol.max_inflight:
+                    if tm:
+                        tm["shed"].inc(1, tenant=tenant, reason="class")
+                    return (429, {"error": f"tenant {tenant!r} (bronze) "
+                                           f"shed under overload"},
+                            "application/json", {"Retry-After": "1"})
+                if tm:
+                    tm["shed"].inc(1, tenant=tenant, reason="inflight")
+                return (503, {"error": f"router tenant in-flight {total} "
+                                       f">= {pol.max_inflight}"},
+                        "application/json", {"Retry-After": "1"})
+            self._tenant_inflight[tenant] = mine + 1
+            self._tenant_inflight_total += 1
+        try:
+            return self._forward_tenant(tenant, body)
+        finally:
+            with self._lock:
+                self._tenant_inflight[tenant] -= 1
+                self._tenant_inflight_total -= 1
+
+    def _forward_tenant(self, tenant: str, body: bytes
+                        ) -> Tuple[int, Any, str, Dict[str, str]]:
+        m = fleet_metrics() if _metrics.enabled() else None
+        # the ring key is (tenant, body): one tenant's identical rows
+        # keep replica affinity (warm runner, no paging churn) without
+        # colliding with another tenant's identical payload
+        candidates, depth = self._candidates_for(
+            tenant.encode("utf-8") + b"\x00" + body)
+        if not candidates:
             if m:
-                m["routed"].inc(1, replica=str(rank))
-            return 200, data, "application/json", {}
+                m["shed"].inc(1, reason="no_replicas")
+            return (503, {"error": "no healthy replicas"},
+                    "application/json", {"Retry-After": "1"})
+        if depth > self.max_queue:
+            if m:
+                m["shed"].inc(1, reason="queue")
+            return (503, {"error": f"fleet queue depth {depth} > "
+                                   f"{self.max_queue}"},
+                    "application/json", {"Retry-After": "1"})
+        if self.policy.hedges(tenant) and len(candidates) >= 2:
+            return self._hedged(candidates, body, tenant)
+        return self._walk(candidates, body, tenant)
+
+    # -- forwarding machinery --------------------------------------------
+    def _candidates_for(self, key: bytes
+                        ) -> Tuple[List[Tuple[int, str, CircuitBreaker]],
+                                   int]:
+        """Ring-ordered routable candidates for ``key`` (capped at
+        1 + failover) plus the fleet-wide probed queue depth."""
+        with self._lock:
+            routable = self._routable_locked()
+            ring = self._ring
+            depth = sum(self._replicas[r].queue_depth for r in routable)
+            candidates = [(r, self._replicas[r].url,
+                           self._replicas[r].breaker)
+                          for r in ring.sequence(key)
+                          if r in routable][:1 + self.failover]
+        return candidates, depth
+
+    def _attempt(self, rank: int, url: str, breaker: CircuitBreaker,
+                 body: bytes, tenant: Optional[str] = None
+                 ) -> Tuple[str, Any]:
+        """One forward to one replica with the breaker discipline →
+        ``("ok", data)`` / ``("shed", HttpError)`` (alive, 503) /
+        ``("client", HttpError)`` (the request's own fault) /
+        ``("skip", None)`` (breaker open) / ``("fail", None)``."""
+        m = fleet_metrics() if _metrics.enabled() else None
+        if not breaker.allow():
+            if m:
+                m["failover"].inc(1, reason="open")
+            return "skip", None
+        try:
+            with _tracectx.span("fleet.forward",
+                                replica=str(rank)) as fwd:
+                hdrs_out = {"Content-Type": "application/json"}
+                if tenant:
+                    hdrs_out[TENANT_HEADER] = tenant
+                if fwd is not None:
+                    hdrs_out[_tracectx.HTTP_HEADER] = fwd.encode()
+                _, _, data = http_request(
+                    "POST", url + "/predict", hdrs_out, body,
+                    ok=(200,), retry=_ONE_ATTEMPT, idempotent=True,
+                    op="fleet_route")
+        except HttpError as e:
+            if e.status == 503:
+                # alive-but-shedding: NOT a breaker failure (see
+                # module docstring) — walk to the next replica
+                breaker.record_success()
+                if m:
+                    m["failover"].inc(1, reason="shed")
+                return "shed", e
+            if 400 <= e.status < 500 and e.status not in (408, 429):
+                # the request's own fault — identical everywhere,
+                # pass the replica's verdict through
+                return "client", e
+            breaker.record_failure()
+            if m:
+                m["failover"].inc(1, reason="transport")
+            return "fail", None
+        except Exception:  # noqa: BLE001 — refused/reset/timeout
+            breaker.record_failure()
+            self._mark_unhealthy(rank)
+            if m:
+                m["failover"].inc(1, reason="transport")
+            return "fail", None
+        breaker.record_success()
+        if m:
+            m["routed"].inc(1, replica=str(rank))
+        return "ok", data
+
+    def _walk(self, candidates: List[Tuple[int, str, CircuitBreaker]],
+              body: bytes, tenant: Optional[str] = None,
+              last_shed: Optional[HttpError] = None
+              ) -> Tuple[int, Any, str, Dict[str, str]]:
+        """Sequential failover walk over ``candidates`` — the router's
+        retry loop (one physical attempt per replica)."""
+        for rank, url, breaker in candidates:
+            kind, payload = self._attempt(rank, url, breaker, body,
+                                          tenant=tenant)
+            if kind == "ok":
+                return 200, payload, "application/json", {}
+            if kind == "shed":
+                last_shed = payload
+            elif kind == "client":
+                return payload.status, payload.body, "application/json", {}
         if last_shed is not None:
             retry_after = last_shed.retry_after
             hdrs = {"Retry-After": str(retry_after if retry_after
@@ -329,6 +455,69 @@ class FleetRouter(HttpServer):
             return 503, last_shed.body, "application/json", hdrs
         return (502, {"error": "no replica answered"},
                 "application/json", {"Retry-After": "1"})
+
+    def _hedged(self, candidates: List[Tuple[int, str, CircuitBreaker]],
+                body: bytes, tenant: str
+                ) -> Tuple[int, Any, str, Dict[str, str]]:
+        """Gold-tenant hedge: race the ring owner against the next
+        candidate when the owner is still in flight after
+        ``DMLC_TENANT_HEDGE_MS``; first success wins (predict is
+        idempotent, so the duplicate is wasted work, not wrong work).
+        Falls back to the ordinary walk over the remaining candidates
+        when both racers fail."""
+        tm = tenant_metrics() if _metrics.enabled() else None
+        cond = threading.Condition()
+        results: List[Tuple[str, str, Any]] = []
+
+        def run(cand: Tuple[int, str, CircuitBreaker], which: str) -> None:
+            kind, payload = self._attempt(cand[0], cand[1], cand[2],
+                                          body, tenant=tenant)
+            with cond:
+                results.append((which, kind, payload))
+                cond.notify_all()
+
+        def spawn(cand: Tuple[int, str, CircuitBreaker],
+                  which: str) -> threading.Thread:
+            t = threading.Thread(target=run, args=(cand, which),
+                                 daemon=True,
+                                 name=f"fleet-hedge-{tenant}-{which}")
+            with self._lock:
+                self._hedge_threads = [x for x in self._hedge_threads
+                                       if x.is_alive()]
+                self._hedge_threads.append(t)
+            t.start()
+            return t
+
+        spawn(candidates[0], "primary")
+        launched = 1
+        with cond:
+            cond.wait_for(lambda: len(results) >= 1,
+                          timeout=self.policy.hedge_ms / 1000.0)
+            primary_done = len(results) >= 1
+        if not primary_done:
+            # owner still in flight after the hedge delay: race it
+            if tm:
+                tm["hedge"].inc(1, outcome="launched")
+            spawn(candidates[1], "hedge")
+            launched = 2
+        with cond:
+            cond.wait_for(lambda: any(k == "ok" for _, k, _ in results)
+                          or len(results) >= launched)
+            snapshot = list(results)
+        for which, kind, payload in snapshot:
+            if kind == "ok":
+                if tm and launched == 2:
+                    tm["hedge"].inc(1, outcome=("won" if which == "hedge"
+                                                else "lost"))
+                return 200, payload, "application/json", {}
+        # both racers failed — keep walking the rest of the ring,
+        # carrying any shed verdict so saturation still answers 503
+        last_shed = next((p for _, k, p in snapshot if k == "shed"), None)
+        for which, kind, payload in snapshot:
+            if kind == "client":
+                return payload.status, payload.body, "application/json", {}
+        return self._walk(candidates[launched:], body, tenant=tenant,
+                          last_shed=last_shed)
 
     def _mark_unhealthy(self, rank: int) -> None:
         """Drop a replica from the ring immediately after a transport

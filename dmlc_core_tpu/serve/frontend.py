@@ -55,12 +55,18 @@ from dmlc_core_tpu.serve.batcher import (BatcherClosedError, DynamicBatcher,
 from dmlc_core_tpu.serve.instruments import serve_metrics
 from dmlc_core_tpu.serve.registry import ModelRegistry
 
-__all__ = ["HttpServer", "ServeFrontend"]
+__all__ = ["HttpServer", "ServeFrontend", "TENANT_HEADER"]
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 408: "Request Timeout",
-            500: "Internal Server Error", 502: "Bad Gateway",
-            503: "Service Unavailable", 504: "Gateway Timeout"}
+            429: "Too Many Requests", 500: "Internal Server Error",
+            502: "Bad Gateway", 503: "Service Unavailable",
+            504: "Gateway Timeout"}
+
+#: request header carrying the tenant namespace a predict belongs to;
+#: set by clients, honored by the router (admission + routing key) and
+#: by replicas (tenant-registry dispatch) — doc/serving.md
+TENANT_HEADER = "X-Dmlc-Tenant"
 
 #: request-body cap — a predict batch of max_batch × a few thousand
 #: features in JSON stays far below this; anything bigger is abuse
@@ -248,9 +254,14 @@ class ServeFrontend(HttpServer):
     def __init__(self, registry: ModelRegistry,
                  host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 1024, max_delay: float = 0.002,
-                 max_queue: int = 256, request_timeout: float = 30.0):
+                 max_queue: int = 256, request_timeout: float = 30.0,
+                 tenants: Optional[Any] = None):
         super().__init__(host=host, port=port, name=registry.name)
         self.registry = registry
+        #: optional TenantRegistry (serve.tenancy) — requests carrying
+        #: the X-Dmlc-Tenant header resolve through it instead of the
+        #: default registry; None answers such requests with 400
+        self.tenants = tenants
         self.request_timeout = request_timeout
         self._batcher = DynamicBatcher(
             self._execute, max_batch=max_batch, max_delay=max_delay,
@@ -331,7 +342,7 @@ class ServeFrontend(HttpServer):
             with self._inflight_lock:
                 self._inflight += 1
             try:
-                return self._handle_predict(body)
+                return self._handle_predict(body, headers)
             finally:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -354,8 +365,10 @@ class ServeFrontend(HttpServer):
 
     def _health(self) -> Dict[str, Any]:
         version = self.registry.current_version()
+        has_model = version is not None or (
+            self.tenants is not None and bool(self.tenants.tenants()))
         status = ("draining" if self._draining.is_set()
-                  else "ok" if version is not None else "no_model")
+                  else "ok" if has_model else "no_model")
         out = {"status": status,
                "version": version,
                "queue_depth": self._batcher.depth(),
@@ -363,9 +376,12 @@ class ServeFrontend(HttpServer):
         if version is not None:
             runner = self.registry.get(version)
             out["batch_buckets"] = sorted(runner.compiled_shapes)
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.summary()
         return out
 
-    def _handle_predict(self, body: bytes
+    def _handle_predict(self, body: bytes,
+                        headers: Optional[Dict[str, str]] = None
                         ) -> Tuple[int, Any, str, Dict[str, str]]:
         fault = _fi.check("serve", ctx="/predict")
         if fault is not None and fault.kind == "error":
@@ -376,29 +392,14 @@ class ServeFrontend(HttpServer):
         if self._draining.is_set():
             return (503, {"error": "draining"},
                     "application/json", {"Retry-After": "1"})
+        tenant = (headers or {}).get(TENANT_HEADER.lower())
+        if tenant:
+            return self._handle_tenant_predict(tenant, body)
         if self.registry.current_version() is None:
             return (503, {"error": "no model published"},
                     "application/json", {"Retry-After": "1"})
         try:
-            payload = json.loads(body)
-            rows = np.asarray(payload["rows"], np.float32)
-            if rows.ndim == 1:
-                rows = rows[None, :]
-            if rows.ndim != 2 or len(rows) == 0:
-                raise ValueError(f"bad rows shape {rows.shape}")
-            if len(rows) > self._batcher.max_batch:
-                raise ValueError(
-                    f"too many rows in one request: {len(rows)} > "
-                    f"max_batch {self._batcher.max_batch}")
-            # client-supplied end-to-end deadline: the batcher sheds a
-            # request whose deadline lapsed while it queued (504) instead
-            # of executing it late — see serve.client.ResilientClient
-            timeout = self.request_timeout
-            if "timeout_ms" in payload:
-                timeout_ms = float(payload["timeout_ms"])
-                if timeout_ms <= 0:
-                    raise ValueError(f"bad timeout_ms {timeout_ms}")
-                timeout = min(timeout, timeout_ms / 1000.0)
+            rows, timeout = self._parse_predict(body)
         except (KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:
             return (400, {"error": f"bad request: {e}"},
@@ -425,4 +426,68 @@ class ServeFrontend(HttpServer):
                 1, version=str(version))
         return (200, {"predictions": np.asarray(preds).tolist(),
                       "version": version},
+                "application/json", {})
+
+    def _parse_predict(self, body: bytes) -> Tuple[np.ndarray, float]:
+        """Shared predict-body validation → ``(rows, timeout_s)``;
+        raises ValueError/KeyError/JSONDecodeError on abuse."""
+        payload = json.loads(body)
+        rows = np.asarray(payload["rows"], np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2 or len(rows) == 0:
+            raise ValueError(f"bad rows shape {rows.shape}")
+        if len(rows) > self._batcher.max_batch:
+            raise ValueError(
+                f"too many rows in one request: {len(rows)} > "
+                f"max_batch {self._batcher.max_batch}")
+        # client-supplied end-to-end deadline: the batcher sheds a
+        # request whose deadline lapsed while it queued (504) instead
+        # of executing it late — see serve.client.ResilientClient
+        timeout = self.request_timeout
+        if "timeout_ms" in payload:
+            timeout_ms = float(payload["timeout_ms"])
+            if timeout_ms <= 0:
+                raise ValueError(f"bad timeout_ms {timeout_ms}")
+            timeout = min(timeout, timeout_ms / 1000.0)
+        return rows, timeout
+
+    def _handle_tenant_predict(self, tenant: str, body: bytes
+                               ) -> Tuple[int, Any, str, Dict[str, str]]:
+        """Predict against a tenant namespace (X-Dmlc-Tenant header).
+
+        Tenant rows execute directly on the tenant's resolved runner —
+        the pow-2 bucket ladder still bounds compiled shapes, but there
+        is no cross-request coalescing (per-tenant micro-batching would
+        need one batcher per resident tenant; the direct path is what
+        keeps a page-in's latency attributable to ONE tenant).  The
+        resolve may transparently warm-restore an evicted model."""
+        if self.tenants is None:
+            return (400, {"error": "tenancy not enabled on this server"},
+                    "application/json", {})
+        try:
+            rows, _timeout = self._parse_predict(body)
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            return (400, {"error": f"bad request: {e}"},
+                    "application/json", {})
+        try:
+            version, runner = self.tenants.current(tenant)
+        except KeyError:
+            return (404, {"error": f"unknown tenant {tenant!r}"},
+                    "application/json", {})
+        except Exception as e:  # noqa: BLE001 — no version activated yet
+            return (503, {"error": f"tenant {tenant!r}: {e}"},
+                    "application/json", {"Retry-After": "1"})
+        try:
+            with _tracectx.span("tenant.predict", tenant=tenant):
+                preds = runner.predict(rows)
+        except Exception as e:  # noqa: BLE001 — model failure != crash
+            return (500, {"error": f"{type(e).__name__}: {e}"},
+                    "application/json", {})
+        if _metrics.enabled():
+            serve_metrics()["version_requests"].inc(
+                1, version=str(version))
+        return (200, {"predictions": np.asarray(preds).tolist(),
+                      "version": version, "tenant": tenant},
                 "application/json", {})

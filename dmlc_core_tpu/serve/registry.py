@@ -40,7 +40,7 @@ from dmlc_core_tpu.serve.instruments import serve_metrics
 from dmlc_core_tpu.serve.runner import ModelRunner
 
 __all__ = ["ModelRegistry", "checkpoint_model", "load_model_checkpoint",
-           "clone_model"]
+           "clone_model", "model_to_bytes", "model_from_bytes"]
 
 #: scratch-key counter for mem:// round-trips of model payloads
 _SCRATCH = itertools.count()
@@ -98,6 +98,20 @@ def _model_from_bytes(blob: bytes) -> Any:
                 MemoryFileSystem._files.pop(key, None)
     raise ValueError(
         f"model checkpoint has unknown magic prefix {blob[:16]!r}")
+
+
+def model_to_bytes(model: Any) -> bytes:
+    """Public form of the save_model byte round trip: the exact payload
+    :func:`checkpoint_model` embeds.  The tenancy tier retains these
+    blobs as its paging source of truth (an evicted model is rebuilt
+    from its blob, so a page-in is bit-identical to the publish)."""
+    return _model_to_bytes(model)
+
+
+def model_from_bytes(blob: bytes) -> Any:
+    """Inverse of :func:`model_to_bytes` — the magic prefix picks the
+    model family, no side-channel needed."""
+    return _model_from_bytes(blob)
 
 
 def clone_model(model: Any) -> Any:

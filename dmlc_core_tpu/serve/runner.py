@@ -168,6 +168,15 @@ class ModelRunner:
         return wall
 
     @property
+    def n_features(self) -> Optional[int]:
+        """Feature width inferred from the model (None when the family
+        exposes no width — callers must then pass ``n_features=`` to
+        :meth:`warmup`).  The tenancy pager keys its restore warmups on
+        this so a page-in re-warms the exact ladder the eviction
+        dropped."""
+        return self._n_features
+
+    @property
     def shape_bound(self) -> int:
         """Maximum distinct batch shapes this runner can ever execute:
         one per bucket on the [min_bucket, max_batch] pow-2 ladder."""

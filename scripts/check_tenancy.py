@@ -1,0 +1,384 @@
+#!/usr/bin/env python
+"""Multi-tenant serving drill for CI: many models, one fleet.
+
+Stands up FleetTracker + 3 tenancy-enabled subprocess replicas (six
+tenants, each with its own v1 HistGBT, residency cap 4 so paging is
+guaranteed) + in-process tenant-aware router, then drives the incidents
+the tenancy tier exists to absorb, with closed-loop bit-verified Zipf
+tenant load running THROUGH every incident:
+
+1. **Poisoned publish** — mid-traffic, a tenant-scoped staged rollout
+   deploys a model trained on permuted labels for ONE tenant.  The
+   per-wave eval gate (holdout MSE vs the v1 baseline, scored against
+   the replica actually serving the new version) must trip, the rollout
+   must roll back, and every OTHER tenant's current pointer and p99 must
+   be untouched — zero dropped, zero wrong across the event.
+2. **Hot-tenant surge** — a second router with a tight admission
+   envelope (low in-flight cap, bronze sheds at 12.5%) takes a Zipf
+   surge whose head is a bronze tenant.  Bronze must shed (429) while
+   gold never class-sheds and nobody drops: overload lands on the class
+   that bought the cheap SLO, not the long tail.
+3. **Paging churn** — round-robin direct predicts over all six tenants
+   on every replica force LRU evictions and compile-cache-backed warm
+   restores; every answer must stay bit-identical to the expected v1
+   predictions.
+
+The JSON report is archived to ``TENANCY_OUT`` (default
+``/tmp/tenancy_drill.json``).  Parent runs under ``DMLC_LOCKCHECK=1`` +
+``DMLC_RACECHECK=1`` + ``DMLC_LEAKCHECK=1`` (reports at
+``TENANCY_RACECHECK_OUT`` / ``TENANCY_LEAKCHECK_OUT``); every process
+spools metrics + trace shards (merged snapshot at
+``TENANCY_METRICS_OUT``, stitched trace at ``TENANCY_TRACE_OUT``), and
+GREEN additionally requires the committed per-tenant SLO scorecard
+(``scripts/slo/tenancy.json``, scorecard at ``TENANCY_SLO_OUT``).
+Exit 0 = drill green.  Usage:
+    python scripts/check_tenancy.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_REPLICAS = 3
+N_ROWS, N_FEAT = 400, 8
+TENANTS = ["t0", "t1", "t2", "t3", "t4", "t5"]
+CLASSES = "gold:t0;bronze:t4,t5"
+POISON = "t2"                      # the tenant whose v2 is poisoned
+RESIDENT_CAP = 4                   # < len(TENANTS): paging guaranteed
+LOAD_S = 6.0
+
+
+def _check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def _wait(pred, timeout_s, label):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    _check(False, f"timed out waiting for {label}")
+
+
+def main() -> None:
+    os.environ.setdefault("DMLC_LOCKCHECK", "1")
+    os.environ.setdefault("DMLC_RACECHECK", "1")
+    os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    os.environ.setdefault("DMLC_TRACE", "1")
+    spool = os.environ.get("DMLC_METRICS_SPOOL") \
+        or tempfile.mkdtemp(prefix="dmlc_tenancy_spool")
+    os.environ["DMLC_METRICS_SPOOL"] = spool
+    t_drill0 = time.time()
+    from dmlc_core_tpu.utils import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    import numpy as np
+
+    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
+                                    racecheck, slo)
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.serve.client import ResilientClient
+    from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
+                                           HttpFleetAdmin, Rollout,
+                                           run_loadgen, spawn_replica)
+    from dmlc_core_tpu.serve.tenancy import (TenantPolicy,
+                                             checkpoint_tenant_model)
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_collect
+
+    spool_writer = metrics_agg.install_spool("drill", 0)
+    out_path = os.environ.get("TENANCY_OUT", "/tmp/tenancy_drill.json")
+    report = {"phases": {}}
+    tmp = tempfile.mkdtemp(prefix="dmlc_tenancy")
+
+    # -- six tenants, six different v1 models (all HistGBT: the tree
+    # engine is bit-exact across batch shapes, so the loadgen's
+    # bit-equality oracle holds through padding AND paging) -------------
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(N_ROWS, N_FEAT)).astype(np.float32)
+    models, npz = {}, {"X": X}
+    for i, t in enumerate(TENANTS):
+        y = (X[:, i % N_FEAT] + X[:, (i + 1) % N_FEAT]
+             * X[:, (i + 2) % N_FEAT] > 0).astype(np.float32)
+        m = HistGBT(n_trees=3 + i, max_depth=3, n_bins=16).fit(X, y)
+        models[t] = (m, y)
+        npz[f"{t}__v1"] = m.predict(X)
+        checkpoint_tenant_model(f"file://{tmp}/{t}_v1.ckpt", t, m,
+                                version=1)
+    # the poisoned v2: same family, labels permuted — a model that
+    # trains fine and serves fine but predicts garbage
+    y_poison = np.random.default_rng(7).permutation(models[POISON][1])
+    m_poison = HistGBT(n_trees=4, max_depth=3, n_bins=16).fit(X, y_poison)
+    poison_uri = f"file://{tmp}/{POISON}_v2.ckpt"
+    checkpoint_tenant_model(poison_uri, POISON, m_poison, version=2)
+    npz[f"{POISON}__v2"] = m_poison.predict(X)   # transient v2 answers
+    expected_npz = os.path.join(tmp, "expected.npz")
+    np.savez(expected_npz, **npz)
+
+    X_hold, y_hold = X[:64], models[POISON][1][:64]
+    base_mse = float(np.mean(
+        (models[POISON][0].predict(X_hold) - y_hold) ** 2))
+
+    child_env = {"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
+                 "DMLC_LOCKCHECK": "1", "DMLC_RACECHECK": "1",
+                 "DMLC_TRACE": "1", "DMLC_METRICS_SPOOL": spool,
+                 "DMLC_TENANT_RESIDENT_CAP": str(RESIDENT_CAP)}
+    tracker = FleetTracker(nworker=8)
+    tracker.start()
+    procs = [spawn_replica("127.0.0.1", tracker.port, max_batch=32,
+                           tenancy=True, extra_env=child_env)
+             for _ in range(N_REPLICAS)]
+    router = surge_router = None
+    try:
+        _wait(lambda: len(tracker.serve_endpoints()) == N_REPLICAS,
+              180, "replica registration")
+        endpoints = dict(tracker.serve_endpoints())
+        admin = HttpFleetAdmin(endpoints)
+        for rank in endpoints:
+            for t in TENANTS:
+                v = admin.load(rank, f"file://{tmp}/{t}_v1.ckpt",
+                               activate=True, tenant=t)
+                assert v == 1
+        _check(True, f"{len(TENANTS)} tenants loaded at v1 on "
+                     f"{N_REPLICAS} replicas (residency cap "
+                     f"{RESIDENT_CAP})")
+        for rank in endpoints:
+            tdoc = admin.health(rank).get("tenants", {})
+            _check(sorted(tdoc) == TENANTS
+                   and all(d["version"] == 1 for d in tdoc.values()),
+                   f"replica {rank} heartbeats all tenants at v1")
+            _check(sum(d["resident"] for d in tdoc.values())
+                   <= RESIDENT_CAP,
+                   f"replica {rank} resident count within cap")
+
+        # steady-state policy: generous admission, gold hedges almost
+        # always (1ms budget) so the hedge path runs under racecheck
+        policy = TenantPolicy(classes=CLASSES, default_class="silver",
+                              quota=0, max_inflight=256,
+                              shed_fraction=0.5, hedge_ms=1)
+        router = FleetRouter(tracker, probe_s=0.2,
+                             policy=policy).start()
+        client = ResilientClient(router.url)
+        preds, ver = client.predict(X[:8], tenant="t1")
+        _check(ver == 1 and np.array_equal(preds,
+                                           npz["t1__v1"][:8]),
+               "routed tenant predict bit-identical to direct v1 predict")
+
+        # -- phase 1: poisoned publish for ONE tenant under Zipf load ----
+        def _loadgen_bg(result, duration, **kw):
+            result.update(run_loadgen(
+                router.url, expected_npz, duration_s=duration, procs=2,
+                threads=3, base_qps=60.0, timeout_ms=20_000,
+                workdir=tmp, env=child_env, tenants=TENANTS, **kw))
+
+        def eval_gate(version):
+            # honest gate: score the holdout against each replica that
+            # actually serves the candidate version for the tenant
+            for rank, url in endpoints.items():
+                tdoc = admin.health(rank).get("tenants", {}).get(
+                    POISON, {})
+                if tdoc.get("version") != version:
+                    continue
+                p, v = ResilientClient(url).predict(X_hold,
+                                                    tenant=POISON)
+                if v != version:
+                    continue
+                mse = float(np.mean((p - y_hold) ** 2))
+                print(f"   gate: replica {rank} {POISON} v{version} "
+                      f"holdout mse {mse:.4f} (v1 baseline "
+                      f"{base_mse:.4f})")
+                if mse > 2.0 * base_mse + 1e-6:
+                    return False
+            return True
+
+        load1 = {}
+        t1 = threading.Thread(target=_loadgen_bg, args=(load1, LOAD_S))
+        t1.start()
+        time.sleep(LOAD_S / 3.0)
+        rollout = Rollout(admin, wave_size=1, settle_s=0.3,
+                          eval_gate=eval_gate,
+                          tenant=POISON).run(poison_uri)
+        _check(rollout["outcome"] == "rolled_back",
+               f"poisoned v2 publish for {POISON} rolled back by the "
+               f"eval gate (waves: {rollout['waves']})")
+        t1.join(timeout=LOAD_S + 300)
+        _check(not t1.is_alive(), "poison-phase load generator finished")
+        _check(load1.get("dropped") == 0 and load1.get("wrong") == 0,
+               f"poisoned publish under load: zero dropped / zero wrong "
+               f"({load1.get('ok')} ok of {load1.get('count')})")
+        _check(load1.get("shed") == 0,
+               "steady-state admission shed nothing")
+        for rank in endpoints:
+            tdoc = admin.health(rank).get("tenants", {})
+            _check(all(tdoc[t]["version"] == 1 for t in TENANTS),
+                   f"replica {rank}: every tenant back on v1 "
+                   f"(rollback isolated to {POISON})")
+        per_t = load1.get("by_tenant", {})
+        _check(sorted(per_t) == TENANTS
+               and all(per_t[t]["ok"] > 0 for t in TENANTS),
+               f"Zipf mix served every tenant "
+               f"({ {t: per_t[t]['ok'] for t in sorted(per_t)} })")
+        report["phases"]["poison"] = {"load": load1, "rollout": rollout,
+                                      "base_mse": base_mse}
+
+        # -- phase 2: hot-bronze surge against a tight envelope ----------
+        # a second router with its own injected policy (the envelope is
+        # constructor state, so no instrumented attrs mutate mid-run):
+        # in-flight cap 8, bronze sheds at 12.5% => any concurrency
+        tight = TenantPolicy(classes=CLASSES, default_class="silver",
+                             quota=0, max_inflight=8,
+                             shed_fraction=0.125, hedge_ms=0)
+        surge_router = FleetRouter(tracker, probe_s=0.2,
+                                   policy=tight).start()
+        surge = {}
+        surge.update(run_loadgen(
+            surge_router.url, expected_npz, duration_s=LOAD_S, procs=2,
+            threads=3, base_qps=300.0, timeout_ms=20_000, workdir=tmp,
+            # two attempts only: a bronze 429 that persists across one
+            # honored Retry-After becomes a terminal shed quickly
+            env=dict(child_env, DMLC_RETRY_MAX_ATTEMPTS="2"),
+            tenants=["t4"] + [t for t in TENANTS if t != "t4"],
+            zipf_a=1.3))
+        _check(surge.get("dropped") == 0 and surge.get("wrong") == 0,
+               f"surge: zero dropped / zero wrong "
+               f"({surge.get('ok')} ok, {surge.get('shed')} shed of "
+               f"{surge.get('count')})")
+        sb = surge.get("by_tenant", {})
+        _check(sb.get("t4", {}).get("shed", 0) >= 1,
+               f"hot bronze tenant shed first "
+               f"(t4 shed {sb.get('t4', {}).get('shed')})")
+        _check(sb.get("t0", {}).get("shed", 0) == 0
+               and sb.get("t0", {}).get("ok", 0) > 0,
+               f"gold rode through the surge unshed "
+               f"(t0 ok {sb.get('t0', {}).get('ok')})")
+        report["phases"]["surge"] = {"load": surge}
+
+        # -- phase 3: paging churn with bit-exact restores ---------------
+        restore_clients = {r: ResilientClient(u)
+                           for r, u in endpoints.items()}
+        for _round in range(2):
+            for rank, c in restore_clients.items():
+                for t in TENANTS:
+                    p, v = c.predict(X[:16], tenant=t)
+                    _check(v == 1 and np.array_equal(
+                        p, npz[f"{t}__v1"][:16]),
+                        f"replica {rank} {t} round {_round}: "
+                        f"restore bit-identical at v1")
+        for rank in endpoints:
+            tdoc = admin.health(rank).get("tenants", {})
+            _check(sum(d["resident"] for d in tdoc.values())
+                   <= RESIDENT_CAP,
+                   f"replica {rank} stayed within residency cap "
+                   f"after churn")
+        report["phases"]["paging"] = {
+            rank: admin.health(rank).get("tenants", {})
+            for rank in endpoints}
+    finally:
+        for r in (router, surge_router):
+            if r is not None:
+                r.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=15)
+                except Exception:  # noqa: BLE001
+                    p.kill()
+        tracker.stop()
+
+    # -- observability plane: merge spools, stitch the trace -------------
+    if spool_writer is not None:
+        spool_writer.close()
+    drill_wall_s = time.time() - t_drill0
+    merged, nprocs = metrics_agg.merge_spool(spool)
+    metrics_out = os.environ.get("TENANCY_METRICS_OUT",
+                                 "/tmp/tenancy_metrics.json")
+    metrics_agg.write_snapshot(metrics_out, merged)
+    _check(nprocs >= N_REPLICAS + 1,
+           f"metrics spool merged {nprocs} processes "
+           f"(artifact at {metrics_out})")
+    ev = merged["metrics"].get("dmlc_tenant_evictions_total", {})
+    ev_total = sum(s["value"] for s in ev.get("series", ()))
+    _check(ev_total >= 1,
+           f"replicas paged tenants out under the cap "
+           f"(dmlc_tenant_evictions_total = {ev_total:.0f})")
+    rs = merged["metrics"].get("dmlc_tenant_restore_seconds", {})
+    rs_count = sum(s.get("count", 0) for s in rs.get("series", ()))
+    _check(rs_count >= 1,
+           f"paged-out tenants warm-restored on demand "
+           f"(dmlc_tenant_restore_seconds count = {rs_count:.0f})")
+
+    trace_out = os.environ.get("TENANCY_TRACE_OUT",
+                               "/tmp/tenancy_trace.json")
+    _, tsummary = trace_collect.collect(spool, trace_out)
+    cross = {tid: t for tid, t in tsummary["traces"].items()
+             if len(t["pids"]) >= 3 and "fleet.route" in t["spans"]
+             and "tenant.predict" in t["spans"]}
+    _check(cross,
+           f"{len(cross)} tenant trace(s) crossed loadgen -> router -> "
+           f"replica tenant.predict over >= 3 processes (merged trace "
+           f"at {trace_out})")
+    report["observability"] = {
+        "spool_processes_merged": nprocs,
+        "traces": len(tsummary["traces"]),
+        "cross_process_tenant_traces": len(cross),
+        "evictions_total": ev_total,
+        "restores_total": rs_count,
+        "drill_wall_s": round(drill_wall_s, 3),
+    }
+
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"   report archived to {out_path}")
+    lockcheck.check()
+    print("ok: zero lock-order cycles under DMLC_LOCKCHECK=1 (parent)")
+    rc_out = os.environ.get("TENANCY_RACECHECK_OUT",
+                            "/tmp/tenancy_racecheck.json")
+    rc_report = racecheck.write_report(rc_out)
+    racecheck.check()
+    print(f"ok: zero happens-before races under DMLC_RACECHECK=1 "
+          f"(parent; report at {rc_out})")
+    lk_out = os.environ.get("TENANCY_LEAKCHECK_OUT",
+                            "/tmp/tenancy_leakcheck.json")
+    lk_report = leakcheck.write_report(lk_out)
+    leakcheck.check()
+    print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
+          f"(parent; report at {lk_out})")
+
+    # -- per-tenant SLO scorecard gate ------------------------------------
+    spec_path = os.environ.get("TENANCY_SLO_SPEC") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "slo", "tenancy.json")
+    evidence = {
+        "loadgen": report["phases"]["poison"]["load"],
+        "surge": report["phases"]["surge"]["load"],
+        "racecheck": {"races": len(rc_report["races"])},
+        "leakcheck": {"leaks": len(lk_report["leaks"])},
+    }
+    scorecard = slo.evaluate(slo.SLOSpec.load(spec_path), merged, evidence)
+    slo_out = os.environ.get("TENANCY_SLO_OUT", "/tmp/tenancy_slo.json")
+    with open(slo_out, "w") as f:
+        json.dump(scorecard, f, indent=2)
+    for row in scorecard["objectives"]:
+        print(f"   slo[{row['name']}]: "
+              f"{'pass' if row['pass'] else 'FAIL'} "
+              f"(observed {row['observed']} {row['op']} "
+              f"{row['threshold']}; {row['evidence']})")
+    _check(scorecard["pass"],
+           f"SLO scorecard {scorecard['spec']} green "
+           f"(spec {spec_path}, scorecard at {slo_out})")
+    print("TENANCY DRILL GREEN")
+
+
+if __name__ == "__main__":
+    main()

@@ -226,6 +226,25 @@ env JAX_PLATFORMS=cpu \
     LAUNCH_METRICS_OUT="${LAUNCH_METRICS_OUT:-/tmp/launch_metrics.json}" \
     python scripts/check_launch.py
 
+echo "== multi-tenant serving drill (poisoned publish / surge / paging) =="
+# many models, one fleet: 6 Zipf-weighted tenants on 3 tenancy-enabled
+# replicas (residency cap 4) behind the tenant-aware router.  A
+# mid-traffic poisoned publish for ONE tenant must be rolled back by
+# its eval gate with every other tenant untouched; a hot-bronze surge
+# against a tight admission envelope must shed bronze (429) before
+# gold sees queueing; LRU paging churn must warm-restore bit-identical
+# predictions.  Runs under lockcheck+racecheck+leakcheck (reports
+# archived) and gates GREEN on the committed per-tenant SLO scorecard
+# scripts/slo/tenancy.json (doc/serving.md "Multi-tenant serving").
+env JAX_PLATFORMS=cpu \
+    TENANCY_OUT="${TENANCY_OUT:-/tmp/tenancy_drill.json}" \
+    TENANCY_RACECHECK_OUT="${TENANCY_RACECHECK_OUT:-/tmp/tenancy_racecheck.json}" \
+    TENANCY_LEAKCHECK_OUT="${TENANCY_LEAKCHECK_OUT:-/tmp/tenancy_leakcheck.json}" \
+    TENANCY_METRICS_OUT="${TENANCY_METRICS_OUT:-/tmp/tenancy_metrics.json}" \
+    TENANCY_TRACE_OUT="${TENANCY_TRACE_OUT:-/tmp/tenancy_trace.json}" \
+    TENANCY_SLO_OUT="${TENANCY_SLO_OUT:-/tmp/tenancy_slo.json}" \
+    python scripts/check_tenancy.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

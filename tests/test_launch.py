@@ -317,6 +317,65 @@ class TestJobSet:
 
 
 # ---------------------------------------------------------------------------
+# slot-aware placement (bin-packing over the slot-expanded host file)
+# ---------------------------------------------------------------------------
+
+class TestSlotAwarePlacement:
+    """``JobSet._place`` packs by FREE slots — declared slots from the
+    slot-expanded host file minus ranks already resident — instead of the
+    old ``rank % len(hosts)`` round-robin that ignored both."""
+
+    @staticmethod
+    def _occupy(js, rank, host):
+        from dmlc_core_tpu.launch.jobset import _Rank
+        from dmlc_core_tpu.launch.transport import WorkerHandle
+
+        st = _Rank(rank)
+        st.handle = WorkerHandle(host, f"r{rank}", {})
+        js._ranks[rank] = st
+
+    def test_slot_counts_beat_round_robin(self, tmp_path):
+        # "b" declares 3 slots, "a" one.  Round-robin would put rank 0
+        # on "a"; bin-packing puts it on the host with capacity.
+        tr = FakeTransport(hosts=["a", "b", "b", "b"], log_dir=str(tmp_path))
+        js = JobSet([PY, "-c", "pass"], 2, transport=tr, monitor_s=0.05)
+        assert js._place(0) == "b"
+        self._occupy(js, 0, "b")
+        assert js._place(1) == "b"          # b still has 2 free vs a's 1
+
+    def test_occupancy_spills_to_free_host(self, tmp_path):
+        tr = FakeTransport(hosts=["a", "a", "b"], log_dir=str(tmp_path))
+        js = JobSet([PY, "-c", "pass"], 3, transport=tr, monitor_s=0.05)
+        self._occupy(js, 0, "a")
+        self._occupy(js, 1, "a")            # a's two slots saturated
+        assert js._place(2) == "b"
+        # a respawn doesn't count its own old placement as load: with
+        # rank 1 excluded a is back to one free slot and wins the tie
+        # on host-file order (blind counting would send it to b)
+        assert js._place(1) == "a"
+
+    def test_dead_hosts_excluded(self, tmp_path):
+        tr = FakeTransport(hosts=["a", "b", "b", "b"], log_dir=str(tmp_path))
+        js = JobSet([PY, "-c", "pass"], 1, transport=tr, monitor_s=0.05)
+        tr.fail_host("b")
+        assert js._place(0) == "a"
+        tr.fail_host("a")
+        with pytest.raises(TransportError):
+            js._place(0)
+
+    def test_live_spawns_pack_by_slots(self, tmp_path):
+        tr = FakeTransport(hosts=["a", "b", "b", "b"], log_dir=str(tmp_path))
+        js = JobSet([PY, "-c", "import time; time.sleep(5)"], 4,
+                    transport=tr, monitor_s=0.05)
+        js.launch()
+        try:
+            hosts = sorted(js.rank_host(r) for r in range(4))
+            assert hosts.count("b") == 3 and hosts.count("a") == 1
+        finally:
+            js.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # dmlc-submit options → JobSet configurations (golden per backend)
 # ---------------------------------------------------------------------------
 

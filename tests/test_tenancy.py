@@ -1,0 +1,255 @@
+"""Multi-tenant serving tier: namespaced registry (per-tenant monotone
+versions, isolated rollback, concurrent publish), LRU paging with
+bit-identical warm restore, (tenant, version) checkpoint round trips
+across every model family, admission policy math, and the Zipf tenant
+sampler the tenancy drill drives load with.
+
+Socket-free on purpose — the router/replica integration runs in
+scripts/check_tenancy.py under lockcheck/racecheck/leakcheck."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base.logging import Error
+from dmlc_core_tpu.serve.fleet.loadgen import sample_tenant, zipf_weights
+from dmlc_core_tpu.serve.tenancy import (TenantPolicy, TenantRegistry,
+                                         checkpoint_tenant_model,
+                                         load_tenant_checkpoint)
+
+
+def _make_data(n=200, F=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def _fit_linear(X, y):
+    from dmlc_core_tpu.models import GBLinear
+
+    return GBLinear(n_rounds=3).fit(X, y)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _make_data()
+
+
+class TestTenantRegistry:
+    def test_per_tenant_monotone_versions(self, data):
+        """Each tenant owns its version counter: publishing under one
+        namespace never advances (or constrains) another's."""
+        X, y = data
+        reg = TenantRegistry(max_batch=8, min_bucket=1)
+        m = _fit_linear(X, y)
+        assert reg.publish("alpha", m) == 1
+        assert reg.publish("alpha", m) == 2
+        assert reg.publish("beta", m) == 1          # own counter
+        assert reg.publish("beta", m, version=7) == 7
+        assert reg.publish("beta", m) == 8
+        with pytest.raises(Error):
+            reg.publish("beta", m, version=3)       # stale within beta
+        assert reg.publish("alpha", m) == 3         # alpha unaffected
+        assert reg.versions("alpha") == [1, 2, 3]
+        assert reg.versions("beta") == [1, 7, 8]
+        with pytest.raises(KeyError):
+            reg.current("nobody")
+
+    def test_rollback_is_isolated(self, data):
+        """Rolling alpha back to v1 must not move beta's pointer — the
+        tenancy contract the fleet rollout leans on."""
+        X, y = data
+        reg = TenantRegistry(max_batch=8, min_bucket=1)
+        m1, m2 = _fit_linear(X, y), _fit_linear(X, 1.0 - y)
+        for t in ("alpha", "beta"):
+            reg.publish(t, m1)
+            reg.publish(t, m2)
+        _, rb_before = reg.current("beta")
+        beta_before = np.asarray(rb_before.predict(X[:8]))
+        reg.activate("alpha", 1)                    # alpha-only rollback
+        assert reg.current_version("alpha") == 1
+        assert reg.current_version("beta") == 2
+        v_a, r_a = reg.current("alpha")
+        np.testing.assert_array_equal(r_a.predict(X[:8]),
+                                      np.asarray(m1.predict(X[:8])))
+        _, r_b = reg.current("beta")
+        np.testing.assert_array_equal(np.asarray(r_b.predict(X[:8])),
+                                      beta_before)
+
+    def test_concurrent_publish_two_tenants(self, data):
+        """Interleaved publishes from two tenants keep both counters
+        monotone and both namespaces intact."""
+        X, y = data
+        reg = TenantRegistry(max_batch=8, min_bucket=1)
+        model = _fit_linear(X, y)
+        n_each, errs = 8, []
+
+        def worker(tenant):
+            try:
+                for _ in range(n_each):
+                    reg.publish(tenant, model)
+            except BaseException as e:  # noqa: BLE001 — surface in main
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in ("alpha", "beta")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        for tenant in ("alpha", "beta"):
+            assert reg.versions(tenant) == list(range(1, n_each + 1))
+            assert reg.current_version(tenant) == n_each
+
+    def test_eviction_and_warm_restore_bit_parity(self, data):
+        """Over the residency cap the LRU tenant is paged out; its next
+        resolve rebuilds from retained bytes and predicts bit-identically
+        to before the eviction."""
+        X, y = data
+        reg = TenantRegistry(resident_cap=1, max_batch=8, min_bucket=1)
+        reg.publish("alpha", _fit_linear(X, y))
+        _, r = reg.current("alpha")
+        before = np.asarray(r.predict(X[:8]))
+        reg.publish("beta", _fit_linear(X, 1.0 - y))   # evicts alpha
+        assert reg.resident() == ["beta"]
+        assert reg.evictions >= 1
+        v, r2 = reg.current("alpha")                   # warm restore
+        assert v == 1
+        assert reg.restores == 1
+        np.testing.assert_array_equal(np.asarray(r2.predict(X[:8])),
+                                      before)
+        assert reg.resident() == ["alpha"]             # beta paged out
+        assert reg.summary()["beta"] == {"version": 1, "resident": False}
+
+    def test_load_rejects_cross_tenant_checkpoint(self, data):
+        X, y = data
+        reg = TenantRegistry(max_batch=8, min_bucket=1)
+        checkpoint_tenant_model("mem:///tenancy/cross", "alpha",
+                                _fit_linear(X, y), version=3)
+        assert reg.load("alpha", "mem:///tenancy/cross") == 3
+        with pytest.raises(Error):                     # wrong namespace
+            reg.load("beta", "mem:///tenancy/cross")
+        with pytest.raises(Error):                     # absent is loud
+            reg.load("alpha", "mem:///tenancy/never-written")
+
+
+def _fit_histgbt(X, y):
+    from dmlc_core_tpu.models import HistGBT
+
+    return HistGBT(n_trees=3, max_depth=3, n_bins=16).fit(X, y)
+
+
+def _fit_sparse(X, y):
+    from dmlc_core_tpu.models import SparseHistGBT
+
+    n, F = X.shape
+    offset = np.arange(0, n * F + 1, F, dtype=np.int64)
+    index = np.tile(np.arange(F, dtype=np.int64), n)
+    m = SparseHistGBT(n_trees=3, max_depth=3, n_bins=16)
+    m.fit(offset, index, X.reshape(-1).copy(), y, n_features=F)
+    return m
+
+
+def _fit_fm(X, y):
+    from dmlc_core_tpu.models.fm import FM
+
+    return FM(n_factors=4, n_epochs=2, seed=0).fit(X, y)
+
+
+def _fit_sk(X, y):
+    from dmlc_core_tpu.models.sklearn import GBTClassifier
+
+    return GBTClassifier(n_estimators=3, max_depth=3, n_bins=16).fit(X, y)
+
+
+def _score(model, X):
+    """Family-agnostic raw predictions: sparse models score a
+    dense-as-present CSR; sklearn wrappers score via the native model
+    (their save_model payload IS the inner model)."""
+    fn = getattr(model, "_predict_native", None)
+    if fn is not None:
+        return np.asarray(fn(X))
+    if hasattr(model, "fit_block"):                    # SparseHistGBT
+        n, F = X.shape
+        return np.asarray(model.predict(
+            np.arange(0, n * F + 1, F, dtype=np.int64),
+            np.tile(np.arange(F, dtype=np.int64), n),
+            np.ascontiguousarray(X.reshape(-1), np.float32)))
+    return np.asarray(model.predict(X))
+
+
+class TestTenantCheckpointRoundTrip:
+    @pytest.mark.parametrize("fit", [
+        _fit_histgbt, _fit_sparse, _fit_linear, _fit_fm, _fit_sk,
+    ], ids=["histgbt", "sparse", "gblinear", "fm", "sklearn"])
+    def test_bit_parity_per_family(self, fit, data):
+        """(tenant, version) checkpoints round-trip every family with
+        bit-identical predictions — the guarantee paging leans on."""
+        X, y = data
+        model = fit(X, y)
+        uri = f"mem:///tenancy/rt-{fit.__name__}"
+        checkpoint_tenant_model(uri, "alpha", model, version=5)
+        tenant, version, again = load_tenant_checkpoint(uri)
+        assert (tenant, version) == ("alpha", 5)
+        np.testing.assert_array_equal(_score(again, X[:16]),
+                                      _score(model, X[:16]))
+
+    def test_absent_checkpoint_sentinel(self):
+        assert load_tenant_checkpoint("mem:///tenancy/absent") == \
+            ("", 0, None)
+
+    def test_version_zero_rejected(self, data):
+        X, y = data
+        with pytest.raises(Error):
+            checkpoint_tenant_model("mem:///tenancy/v0", "alpha",
+                                    _fit_linear(X, y), version=0)
+
+
+class TestTenantPolicy:
+    def test_class_parsing_and_thresholds(self):
+        pol = TenantPolicy(classes="gold: vip ; bronze: batch,scrape",
+                           default_class="silver", quota=4,
+                           max_inflight=40, shed_fraction=0.25,
+                           hedge_ms=10)
+        assert pol.class_of("vip") == "gold"
+        assert pol.class_of("batch") == "bronze"
+        assert pol.class_of("anyone-else") == "silver"
+        assert pol.shed_threshold("batch") == 10       # 0.25 * 40
+        assert pol.shed_threshold("vip") == 40
+        assert pol.shed_threshold("anyone-else") == 40
+        assert pol.hedges("vip") and not pol.hedges("anyone-else")
+
+    def test_hedging_needs_budget(self):
+        pol = TenantPolicy(classes="gold:vip", default_class="silver",
+                           quota=0, max_inflight=8, shed_fraction=0.5,
+                           hedge_ms=0)
+        assert not pol.hedges("vip")                   # hedge_ms == 0
+
+    def test_bad_specs_are_loud(self):
+        with pytest.raises(Error):
+            TenantPolicy(classes="platinum:x", default_class="silver",
+                         quota=0, max_inflight=8, shed_fraction=0.5,
+                         hedge_ms=0)
+        with pytest.raises(Error):
+            TenantPolicy(classes="", default_class="silver", quota=0,
+                         max_inflight=8, shed_fraction=1.5, hedge_ms=0)
+
+
+class TestZipfTenantSampler:
+    def test_cumulative_weights(self):
+        cum = zipf_weights(4, 1.0)
+        assert cum[-1] == pytest.approx(1.0)
+        probs = np.diff(np.concatenate([[0.0], cum]))
+        assert np.all(probs[:-1] > probs[1:])          # strictly skewed
+
+    def test_hot_head_long_tail(self):
+        tenants = [f"t{i}" for i in range(6)]
+        cum = zipf_weights(len(tenants), 1.1)
+        rng = np.random.default_rng(7)
+        draws = [sample_tenant(rng, tenants, cum) for _ in range(2000)]
+        counts = [draws.count(t) for t in tenants]
+        assert counts[0] == max(counts)                # head is hottest
+        assert min(counts) > 0                         # tail still served
