@@ -320,7 +320,7 @@ def _install_guards(deadline):
 
 def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
                      n_chips=1, layout=None, grow_policy="depthwise",
-                     max_leaves=0):
+                     max_leaves=0, fused=False, quant=False):
     """Auditable per-round cost model of the sibling-subtracted round.
 
     MXU flops: per level ℓ the Pallas histogram dot is [A, T]·[T, lo]
@@ -335,8 +335,12 @@ def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
     (the rabit-allreduce replacement).  The ``kernel`` block is the
     ISSUE 12 lever evidence: bin-matrix bytes one round's passes pull
     from HBM, and how many node histograms the round actually builds
-    (loss-guide builds ``max_leaves`` instead of ``2^(depth-1)``)."""
+    (loss-guide builds ``max_leaves`` instead of ``2^(depth-1)``).
+    ``fused``/``quant`` are the ISSUE 18 levers: the fused round kernel
+    halves the bin-matrix passes (descend rides the histogram read) and
+    the int8 sync shrinks each synced node ~4×."""
     from dmlc_core_tpu.ops.histogram import (_lo_factor,
+                                             bins_bytes_per_round,
                                              hist_psum_bytes_per_round,
                                              leaves_built_per_round)
 
@@ -346,7 +350,7 @@ def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
     # dmlc_histogram_psum_bytes_total counter the engine increments
     psum_bytes = hist_psum_bytes_per_round(
         depth, feats, n_bins, layout=layout, grow_policy=grow_policy,
-        max_leaves=max_leaves)
+        max_leaves=max_leaves, quant=quant)
     sync_bins = layout.sync_bins if layout is not None else n_bins
     for level in range(depth):
         n_build = 1 if level == 0 else 1 << (level - 1)
@@ -358,12 +362,9 @@ def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
     row_bytes = (layout.phys_bytes_per_row() if layout is not None
                  else feats)
     leaves_built = leaves_built_per_round(depth, grow_policy, max_leaves)
-    if grow_policy == "lossguide":
-        # root build + (hist build + descend) per expansion
-        passes = 2 * leaves_built - 1
-    else:
-        passes = 2 * depth - 1            # depth hist + depth-1 descend
-    bins_bytes = passes * rows * row_bytes
+    bins_bytes = bins_bytes_per_round(
+        depth, rows, row_bytes, grow_policy=grow_policy,
+        max_leaves=max_leaves, fused=fused)
     hbm = bins_bytes + 6 * rows * 4       # + g/h/preds/update f32 vectors
     peak = _PEAK_BF16.get(platform, 0)
     mfu = (mxu_flops / seconds_per_round / peak) if peak else None
@@ -381,8 +382,32 @@ def _derived_metrics(rows, feats, depth, n_bins, seconds_per_round, platform,
             "bin_layout": (None if layout is None else
                            f"{layout.n_features}F->{layout.phys_rows}rows"
                            f"/{len(layout.pairs)}pairs"),
+            "fused_round": fused,
+            "hist_quant": quant,
         },
     }
+
+
+def _fused_round_engaged(platform, n_chips, layout, feats, depth, n_bins):
+    """Whether the DMLC_FUSED_ROUND lever actually engages for this
+    bench config — mirrors the eligibility gate in
+    models.histgbt._build_round_fn so the ``kernel`` evidence block
+    reports what the round program really dispatched."""
+    mode = os.environ.get("DMLC_FUSED_ROUND", "auto")
+    if (mode == "0" or n_chips > 1
+            or int(os.environ.get("DMLC_HIST_BLOCKS", "0") or 0)):
+        return False
+    if mode == "1":
+        return True
+    if platform != "tpu":
+        return False
+    from dmlc_core_tpu.ops.histogram import fused_round_ok
+
+    sync_bins = layout.sync_bins if layout is not None else n_bins
+    phys = layout.phys_rows if layout is not None else feats
+    return fused_round_ok(sync_bins, phys,
+                          max(1 << max(depth - 2, 0), 1),
+                          with_layout=layout is not None)
 
 
 def chunk_stats(chunk_times, total_rounds, total_seconds):
@@ -482,9 +507,20 @@ def _scaling_probe() -> None:
     from dmlc_core_tpu.utils import force_cpu_devices
     force_cpu_devices(8)
 
+    from dmlc_core_tpu.base import compile_cache as _cc
     from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.ops.histogram import hist_psum_bytes_per_round
     from dmlc_core_tpu.parallel.mesh import local_mesh
 
+    # the parent passes its DMLC_COMPILE_CACHE_DIR through the
+    # environment — configure it here too, or the probe re-pays every
+    # round-program compile the main run already cached (the r06
+    # scaling_efficiency=0.1258 was mostly that compile wall)
+    _cc.configure()
+
+    # BENCH_PROBE_ROWS is pinned by the parent to the MAIN run's row
+    # count, so baseline and probe rates are at comparable arithmetic
+    # intensity; the 160k default only covers a bare --scaling-probe
     rows = int(os.environ.get("BENCH_PROBE_ROWS", 160_000))
     feats = int(os.environ.get("BENCH_FEATURES", 28))
     rounds = int(os.environ.get("BENCH_PROBE_ROUNDS", 10))
@@ -495,20 +531,30 @@ def _scaling_probe() -> None:
     X = rng.normal(size=(rows, feats)).astype(np.float32)
     y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
     cuts = _host_cuts(X, n_bins)
+    layout = {}
 
     def per_chip_rate(width):
         m = HistGBT(n_trees=rounds, max_depth=depth, n_bins=n_bins,
                     learning_rate=0.1, mesh=local_mesh(width))
         dd = m.make_device_data(X, y, cuts=cuts)
         m.fit_device(dd, warmup_rounds=1)
+        layout[width] = m._bin_layout
         return rounds / m.last_fit_seconds / width
 
     r8 = per_chip_rate(8)
     out = scaling_summary(8, r8, per_chip_rate(1)) or {}
+    # the byte bill behind the efficiency number: what each chip
+    # contributes to the per-round histogram-sync allreduce
+    out["hist_psum_bytes_per_round"] = hist_psum_bytes_per_round(
+        depth, feats, n_bins, layout=layout.get(8),
+        grow_policy=os.environ.get("DMLC_GROW_POLICY", "depthwise"),
+        max_leaves=int(os.environ.get("DMLC_MAX_LEAVES", "0") or 0),
+        quant=os.environ.get("DMLC_HIST_QUANT", "0") == "1")
     out["basis"] = (
         f"virtual-8-device CPU probe at rows={rows} (host exposes 1 "
-        "chip): measures the round program's mesh fold + histogram-psum "
-        "overhead on the XLA CPU backend, not TPU ICI bandwidth")
+        "chip), warm persistent compile cache: measures the round "
+        "program's mesh fold + histogram-psum overhead on the XLA CPU "
+        "backend, not TPU ICI bandwidth")
     with _EMIT_LOCK:
         sys.stdout.write(json.dumps(out) + "\n")
         sys.stdout.flush()
@@ -1466,6 +1512,10 @@ def main() -> None:
                         "grow_policy": os.environ["DMLC_GROW_POLICY"],
                         "max_leaves":
                             int(os.environ["DMLC_MAX_LEAVES"] or 0),
+                        "fused_round":
+                            os.environ.get("DMLC_FUSED_ROUND", "auto"),
+                        "hist_quant":
+                            os.environ.get("DMLC_HIST_QUANT", "0") == "1",
                     }}
 
     # chips=N mode (ISSUE 7): BENCH_CHIPS pins the data-mesh width (0 /
@@ -1554,6 +1604,12 @@ def main() -> None:
         if model.last_warm_dispatch_seconds is not None:
             out["warm_dispatch_seconds"] = round(
                 model.last_warm_dispatch_seconds, 3)
+        # {trace, dispatch, device} attribution of warm_dispatch (the
+        # r06 regression lever: 98 s of "warm dispatch" was the exec
+        # warmup running the full K-round chunk on CPU — now the exec
+        # is DMLC_WARMUP_EXEC-gated and trace = inline AOT compile)
+        if model.last_warmup_breakdown is not None:
+            out["warmup_breakdown"] = model.last_warmup_breakdown
         out["compile_cache"] = model.last_compile_cache or "warm"
         out.update(chunk_stats(model.last_chunk_times, rounds, seconds))
         # time from entering the timed fit to the FIRST trained trees
@@ -1616,7 +1672,14 @@ def main() -> None:
         1.0 / (value * n_chips), EV["platform"], n_chips,
         layout=model._bin_layout,
         grow_policy=os.environ.get("DMLC_GROW_POLICY", "depthwise"),
-        max_leaves=int(os.environ.get("DMLC_MAX_LEAVES", "0") or 0)))
+        max_leaves=int(os.environ.get("DMLC_MAX_LEAVES", "0") or 0),
+        fused=_fused_round_engaged(EV["platform"], n_chips,
+                                   model._bin_layout, feats, depth,
+                                   n_bins),
+        quant=(os.environ.get("DMLC_HIST_QUANT", "0") == "1"
+               and n_chips > 1
+               and not int(os.environ.get("DMLC_HIST_BLOCKS", "0")
+                           or 0))))
     EV["official"] = official
     EV["runs"] = runs
     emit()           # headline is now on stdout before scaling/smokes
@@ -1682,6 +1745,10 @@ def main() -> None:
                 import subprocess
                 env = {**os.environ, "JAX_PLATFORMS": "cpu"}
                 env.pop("BENCH_FORCE_CPU", None)
+                # probe at the MAIN run's rows (not the 160k default)
+                # with the same warmed compile-cache dir, so the
+                # efficiency ratio compares like against like
+                env.setdefault("BENCH_PROBE_ROWS", str(rows))
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
                      "--scaling-probe"],

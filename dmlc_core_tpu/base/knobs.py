@@ -199,6 +199,30 @@ declare("DMLC_BIN_PACK", "0",
         "compact-remapped and nibble-paired, shrinking the HBM bin "
         "traffic every histogram pass pays; split decisions and "
         "save_model bytes are bit-identical.", "gbt")
+declare("DMLC_FUSED_ROUND", "auto",
+        "Fully-fused Pallas round kernel: ONE program per level "
+        "(depthwise) or expansion (lossguide) doing bin-read, node "
+        "descend, g/h accumulation and sibling subtraction with the "
+        "node histograms VMEM-resident — no HBM round-trip between "
+        "phases.  'auto' engages on TPU at eligible shapes "
+        "(single-chip, no DMLC_HIST_BLOCKS, no missing values, pallas "
+        "hist_method), '1' forces it everywhere (interpret mode "
+        "off-TPU — the byte-parity test hook), '0' pins the "
+        "three-dispatch path; save_model bytes identical either "
+        "way.", "gbt")
+declare("DMLC_HIST_QUANT", "0",
+        "1 quantizes the multi-chip histogram sync to int8 codes plus "
+        "an exact f32 per-column total (the correction term): ~4x "
+        "fewer allreduce bytes at n_bins=256, bounded per-cell error "
+        "(n_chips*scale/2), EXACT per-(node,feature) grad/hess totals. "
+        "No-op on one chip and under DMLC_HIST_BLOCKS.", "gbt")
+declare("DMLC_WARMUP_EXEC", "auto",
+        "Whether the fit warmup EXECUTES the round programs after "
+        "compiling them: 'auto' executes on TPU only (first dispatch "
+        "pays real staging there), '1' forces execution everywhere, "
+        "'0' compiles/AOT-warms only — on CPU an exec-warmup just runs "
+        "the whole first dispatch chunk twice (the BENCH_r06 98s "
+        "warm_dispatch).", "gbt")
 declare("DMLC_FEATURE_BUNDLE", "0",
         "1 fuses mutually-exclusive (near-one-hot) feature blocks into "
         "one multi-bin storage feature (LightGBM's EFB with the "

@@ -52,8 +52,11 @@ from dmlc_core_tpu.data.device_feed import assemble_row_sharded
 from dmlc_core_tpu.data.iter import slab_shard_slices
 from dmlc_core_tpu.ops import binlayout as _bl
 from dmlc_core_tpu.ops.histogram import (build_histogram,
+                                         dequantize_hist_sum,
                                          fused_descend_histogram,
+                                         fused_round, fused_round_ok,
                                          hist_psum_bytes_per_round,
+                                         quantize_hist_partial,
                                          select_feature_bins)
 from dmlc_core_tpu.ops.quantile import (apply_bins, apply_bins_missing,
                                         compute_cuts)
@@ -173,6 +176,44 @@ def _feature_bundle_requested() -> bool:
     feature blocks into one multi-bin storage feature (EFB), with exact
     unbundling at split evaluation (ops.binlayout.detect_bundles)."""
     return os.environ.get("DMLC_FEATURE_BUNDLE", "0") == "1"
+
+
+def _fused_round_mode() -> str:
+    """``DMLC_FUSED_ROUND``: the fully-fused Pallas round kernel
+    (ops.histogram.fused_round — one program per level/expansion doing
+    bin-read → descend → accumulate → sibling subtraction in VMEM).
+    ``auto`` (default) turns it on for TPU backends at eligible shapes;
+    ``1`` forces it everywhere (interpret mode off-TPU — the parity-test
+    hook); ``0`` pins the three-dispatch path."""
+    v = os.environ.get("DMLC_FUSED_ROUND", "auto")
+    CHECK(v in ("auto", "0", "1"),
+          f"DMLC_FUSED_ROUND must be 'auto', '0' or '1', got {v!r}")
+    return v
+
+
+def _hist_quant_requested() -> bool:
+    """``DMLC_HIST_QUANT=1``: int8-quantized histogram sync — each chip
+    psums int8 partial-histogram codes plus an exact f32 per-column
+    total (the correction term) instead of raw f32 cells, cutting
+    allreduce bytes ~4× at n_bins=256.  Approximate (bounded cell
+    error, exact column totals); default off, no-op on one chip and
+    under the DMLC_HIST_BLOCKS deterministic fold (which stays exact)."""
+    return os.environ.get("DMLC_HIST_QUANT", "0") == "1"
+
+
+def _warmup_exec_mode() -> str:
+    """``DMLC_WARMUP_EXEC``: whether the warmup ladder EXECUTES the
+    round programs after compiling them.  ``auto`` (default) executes
+    only on TPU backends, where the first dispatch pays real one-time
+    staging (H2D layout, SMEM program load) worth pulling out of the
+    timed region; on CPU the compiled programs have no such cost and an
+    exec-warmup would just run the whole K-round chunk twice.  ``1``
+    forces the execution everywhere, ``0`` never executes (compile/AOT
+    warm only)."""
+    v = os.environ.get("DMLC_WARMUP_EXEC", "auto")
+    CHECK(v in ("auto", "0", "1"),
+          f"DMLC_WARMUP_EXEC must be 'auto', '0' or '1', got {v!r}")
+    return v
 
 
 @lru_cache(maxsize=32)
@@ -489,6 +530,12 @@ class HistGBT(_ExternalMemoryEngine):
         self.last_bin_seconds: Optional[float] = None
         self.last_compile_seconds: Optional[float] = None
         self.last_warm_dispatch_seconds: Optional[float] = None
+        #: {trace, dispatch, device} split of warm_dispatch: trace =
+        #: inline lower+compile of the dispatch programs; dispatch =
+        #: async-enqueue wall of the (DMLC_WARMUP_EXEC-gated) exec
+        #: warmup; device = its completion fetch.  Attributes a warmup
+        #: regression to re-tracing vs dispatch latency vs device time.
+        self.last_warmup_breakdown: Optional[Dict[str, float]] = None
         self.last_compile_cache: Optional[str] = None
         self._pending_warmup: Optional[_RoundProgramWarmup] = None
         #: active packed/bundled bin layout (ops.binlayout.BinLayout) of
@@ -791,15 +838,15 @@ class HistGBT(_ExternalMemoryEngine):
         join_wait = 0.0
         self.last_compile_seconds = None
         self.last_compile_cache = None
+        row_sh = NamedSharding(self.mesh, P("data"))
+        margin_sh = (NamedSharding(self.mesh, P("data", None))
+                     if p.num_class > 1 else row_sh)
+        shardings_ok = (
+            bins_t.sharding == NamedSharding(self.mesh,
+                                             P(None, "data"))
+            and y_d.sharding == row_sh and w_d.sharding == row_sh
+            and preds.sharding == margin_sh)
         if warm is not None:
-            row_sh = NamedSharding(self.mesh, P("data"))
-            margin_sh = (NamedSharding(self.mesh, P("data", None))
-                         if p.num_class > 1 else row_sh)
-            shardings_ok = (
-                bins_t.sharding == NamedSharding(self.mesh,
-                                                 P(None, "data"))
-                and y_d.sharding == row_sh and w_d.sharding == row_sh
-                and preds.sharding == margin_sh)
             execs = warm.join()              # never leave workers behind
             if shardings_ok and warm.matches(
                     self._round_fn_cache_key, n_features,
@@ -821,19 +868,64 @@ class HistGBT(_ExternalMemoryEngine):
         if rem and rem_fn is None:
             rem_fn = rem_jit
 
+        trace_s = dispatch_s = device_s = 0.0
+
         def warm_dispatch(kf, rf):
-            # compile + cache-warm on a copy so the real buffer stays
-            # valid and model state is untouched (preds is donated).
-            # np.asarray (not block_until_ready): on remote-tunnel devices
+            # exec-warm on a copy so the real buffer stays valid and
+            # model state is untouched (preds is donated).  The enqueue
+            # returning is `dispatch`; np.asarray (not
+            # block_until_ready) is `device`: on remote-tunnel devices
             # only a real data fetch proves execution finished
+            nonlocal dispatch_s, device_s
+            t_d = get_time()
             out = run(kf, jnp.copy(preds), 0)
+            out2 = run(rf, jnp.copy(preds), 0) if rf is not None else None
+            dispatch_s += get_time() - t_d
+            t_v = get_time()
             np.asarray(out[0][:1])
-            if rf is not None:
-                out = run(rf, jnp.copy(preds), 0)
-                np.asarray(out[0][:1])
+            if out2 is not None:
+                np.asarray(out2[0][:1])
+            device_s += get_time() - t_v
 
         t_w = get_time()
-        if warmup_rounds > 0:
+        if warmup_rounds > 0 and not using_aot:
+            # first-dispatch tracing + compilation pulled out of the
+            # round loop: lower the exact programs against the LIVE
+            # buffers (lowering never executes or donates) and compile —
+            # a warm persistent cache collapses that to a disk read.
+            # The executables are adopted exactly like the overlapped
+            # warmup path's, and published for later fits only when the
+            # buffers carry the canonical shardings they key on.
+            t_tr = get_time()
+            aot_args = (bins_t, y_d, w_d, preds) + (
+                (jax.random.fold_in(base_key, round_offset),)
+                if sampling else ())
+            try:
+                k_aot = kfn_jit.lower(*aot_args).compile()
+                r_aot = (rem_jit.lower(*aot_args).compile()
+                         if rem else None)
+            except Exception as e:  # noqa: BLE001
+                LOG("WARNING", "inline AOT warm compile failed "
+                    "(%s: %s) — first dispatch will compile",
+                    type(e).__name__, e)
+            else:
+                n_padded = int(bins_t.shape[1])
+                if shardings_ok:
+                    _AOT_EXEC_CACHE[(self._round_fn_cache_key(
+                        n_features, K), n_features, n_padded)] = k_aot
+                    if rem:
+                        _AOT_EXEC_CACHE[(self._round_fn_cache_key(
+                            n_features, rem), n_features,
+                            n_padded)] = r_aot
+                kfn = k_aot
+                if rem:
+                    rem_fn = r_aot
+                using_aot = True
+            trace_s = get_time() - t_tr
+        exec_mode = _warmup_exec_mode()
+        if warmup_rounds > 0 and (
+                exec_mode == "1" or (exec_mode == "auto"
+                                     and jax.default_backend() == "tpu")):
             try:
                 warm_dispatch(kfn, rem_fn)
             except Exception as e:  # noqa: BLE001
@@ -851,6 +943,11 @@ class HistGBT(_ExternalMemoryEngine):
         self.last_warm_dispatch_seconds = get_time() - t_w
         self.last_warmup_seconds = join_wait + \
             self.last_warm_dispatch_seconds
+        self.last_warmup_breakdown = {
+            "trace": round(trace_s, 6),
+            "dispatch": round(dispatch_s, 6),
+            "device": round(device_s, 6),
+        }
         if _metrics.enabled() and warmup_rounds > 0:
             gbt_metrics()["phase"].observe(self.last_warmup_seconds,
                                            engine="incore", phase="warmup")
@@ -864,8 +961,9 @@ class HistGBT(_ExternalMemoryEngine):
         psum_round_bytes = (hist_psum_bytes_per_round(
             p.max_depth, n_features, p.n_bins,
             layout=self._bin_layout, grow_policy=_grow_policy(),
-            max_leaves=_max_leaves()) * max(p.num_class, 1)
-            if dsize > 1 else 0)
+            max_leaves=_max_leaves(),
+            quant=_hist_quant_requested() and not _hist_blocks(dsize))
+            * max(p.num_class, 1) if dsize > 1 else 0)
 
         t0 = get_time()
         chunks: List[Any] = []
@@ -1737,6 +1835,8 @@ class HistGBT(_ExternalMemoryEngine):
                 p.hist_method, obj_key, mono, p.subsample,
                 p.colsample_bytree, p.num_class, self._missing,
                 os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"),
+                os.environ.get("DMLC_FUSED_ROUND", "auto"),
+                os.environ.get("DMLC_HIST_QUANT", "0"),
                 _hist_blocks(int(self.mesh.shape["data"])),
                 _grow_policy(), _max_leaves(), self._bin_layout)
 
@@ -1808,6 +1908,38 @@ class HistGBT(_ExternalMemoryEngine):
         # evaluation, so split decisions — and save_model bytes — are
         # untouched.  None traces the exact seed program.
         layout = self._bin_layout
+        # fully-fused round kernel (ops.fused_round): ONE Pallas program
+        # per level/expansion — descend, left-child accumulation and
+        # sibling subtraction with the bin tile and both child histogram
+        # slabs resident in VMEM.  "auto" engages on a real TPU backend
+        # at shapes inside the kernel's VMEM budget (deepest level is
+        # the binding one); "1" forces it anywhere (interpret mode
+        # off-TPU — the byte-parity test hook).  The fused subtraction
+        # consumes the ALREADY-synced parent histograms, so it needs the
+        # trivial single-chip sync: multi-chip meshes, the deterministic
+        # block fold and the learned-missing descend all take the exact
+        # three-dispatch fallback — byte parity either way.  The kernel
+        # accumulates in the pallas method's tile/matmul order, so an
+        # explicit segment/matmul hist_method also pins the fallback
+        # (real-gradient f32 sums are order-sensitive; parity holds only
+        # against the same order).
+        fr_mode = _fused_round_mode()
+        _Bs_k = layout.sync_bins if layout is not None else B
+        _phys_rows = (layout.phys_rows if layout is not None
+                      else n_features)
+        fused_rounds = (not missing and dsize == 1 and det_blocks == 0
+                        and method in ("auto", "pallas")
+                        and (fr_mode == "1"
+                             or (fr_mode == "auto"
+                                 and jax.default_backend() == "tpu"
+                                 and fused_round_ok(
+                                     _Bs_k, _phys_rows,
+                                     max(1 << max(depth - 2, 0), 1),
+                                     with_layout=layout is not None))))
+        # int8-quantized histogram sync (DMLC_HIST_QUANT): only the
+        # plain multi-chip psum path quantizes — one chip has no wire to
+        # save, and the deterministic block fold stays exact
+        hist_quant = _hist_quant_requested() and dsize > 1
         grow_policy = _grow_policy()
         lossguide = grow_policy == "lossguide"
         if lossguide:
@@ -1904,8 +2036,19 @@ class HistGBT(_ExternalMemoryEngine):
                 """Histogram-sync allreduce over the data axis: a plain
                 psum normally; in deterministic mode an all_gather (no
                 arithmetic) + the same fixed-order fold the per-shard
-                partials used, so total = the one mesh-invariant tree."""
+                partials used, so total = the one mesh-invariant tree.
+                DMLC_HIST_QUANT swaps the plain psum for an int8-code
+                psum + exact f32 column-total correction (~4× fewer
+                wire bytes; see ops.histogram.quantize_hist_partial)."""
                 if not n_blk:
+                    if hist_quant:
+                        gmax = jax.lax.pmax(
+                            jnp.max(jnp.abs(x), axis=-1, keepdims=True),
+                            "data")
+                        q, scale, tot = quantize_hist_partial(x, gmax)
+                        qs = jax.lax.psum(q.astype(jnp.int32), "data")
+                        tots = jax.lax.psum(tot, "data")
+                        return dequantize_hist_sum(qs, scale, tots)
                     return jax.lax.psum(x, "data")
                 if dsize == 1:
                     return x
@@ -1925,6 +2068,7 @@ class HistGBT(_ExternalMemoryEngine):
                                     jnp.full(1, jnp.inf, jnp.float32)], 1)
             for level in range(depth):
                 n_nodes = 1 << level
+                scores = None
                 if level == 0:
                     if n_blk:
                         hist = _tree_fold([
@@ -1947,7 +2091,26 @@ class HistGBT(_ExternalMemoryEngine):
                     thr_sel = table_select(thr, node, n_prev)         # [n]
                     dir_sel = (table_select(dirv, node, n_prev)
                                if missing else None)
-                    if n_blk:
+                    if fused_rounds:
+                        # ONE Pallas program: descend + accumulate +
+                        # sibling subtraction in VMEM; split scoring
+                        # (the SAME closures as the unfused chain, so
+                        # byte parity holds by construction) runs on
+                        # the kernel's emitted per-node histograms
+                        want_sums = (mono_arr is not None
+                                     or level == depth - 1)
+
+                        def score_fn(hs, _w=want_sums, _b=bounds):
+                            ev = _bl.unbundle_hist(hs, layout, B)
+                            if _w:
+                                return best_split_leaf(ev, feat_mask, _b)
+                            return best_split(ev, feat_mask)
+
+                        node, hist, scores = fused_round(
+                            bins_tl, node, feat_sel, thr_sel, g, h,
+                            prev_hist, n_prev, B, layout=layout,
+                            score_fn=score_fn)
+                    elif n_blk:
                         lefts, nodes2 = [], []
                         for j in range(n_blk):
                             sl = slice(j * rb, (j + 1) * rb)
@@ -1970,28 +2133,40 @@ class HistGBT(_ExternalMemoryEngine):
                             dir_sel=dir_sel,
                             miss_bin=B - 1 if missing else None,
                             layout=layout)
-                    left = hist_sync(left)
-                    right = prev_hist - left
-                    hist = jnp.stack([left, right], axis=2).reshape(
-                        2, n_nodes, left.shape[2], left.shape[3])
+                    if not fused_rounds:
+                        left = hist_sync(left)
+                        right = prev_hist - left
+                        hist = jnp.stack([left, right], axis=2).reshape(
+                            2, n_nodes, left.shape[2], left.shape[3])
                 # sibling subtraction stays in STORAGE space (prev_hist);
                 # split evaluation sees original-feature space (identity
                 # when layout is None)
                 prev_hist = hist
-                hist = _bl.unbundle_hist(hist, layout, B)
-                if mono_arr is not None or level == depth - 1:
-                    if missing:
-                        feat, thr, dirv, gn, cg_, ch_ = best_split_leaf(
-                            hist, feat_mask, bounds)
+                if scores is not None:
+                    # fused level: the per-node (feat, thr, gain, child
+                    # stats) tuple came with the round kernel's outputs
+                    # — the SAME closures, so identical values/bytes
+                    if mono_arr is not None or level == depth - 1:
+                        feat, thr, gn, cg_, ch_ = scores
+                        if level == depth - 1:
+                            gsum, hsum = cg_, ch_
                     else:
-                        feat, thr, gn, cg_, ch_ = best_split_leaf(
-                            hist, feat_mask, bounds)
-                    if level == depth - 1:
-                        gsum, hsum = cg_, ch_
-                elif missing:
-                    feat, thr, dirv, gn = best_split(hist, feat_mask)
+                        feat, thr, gn = scores
                 else:
-                    feat, thr, gn = best_split(hist, feat_mask)
+                    hist = _bl.unbundle_hist(hist, layout, B)
+                    if mono_arr is not None or level == depth - 1:
+                        if missing:
+                            feat, thr, dirv, gn, cg_, ch_ = \
+                                best_split_leaf(hist, feat_mask, bounds)
+                        else:
+                            feat, thr, gn, cg_, ch_ = best_split_leaf(
+                                hist, feat_mask, bounds)
+                        if level == depth - 1:
+                            gsum, hsum = cg_, ch_
+                    elif missing:
+                        feat, thr, dirv, gn = best_split(hist, feat_mask)
+                    else:
+                        feat, thr, gn = best_split(hist, feat_mask)
                 # pad per-level arrays to a common width for stacking
                 feats.append(jnp.pad(feat, (0, half - n_nodes)))
                 thrs.append(jnp.pad(thr, (0, half - n_nodes)))
@@ -2076,6 +2251,14 @@ class HistGBT(_ExternalMemoryEngine):
 
             def hist_sync(x):
                 if not n_blk:
+                    if hist_quant:          # int8-code sync, see grow_tree
+                        gmax = jax.lax.pmax(
+                            jnp.max(jnp.abs(x), axis=-1, keepdims=True),
+                            "data")
+                        q, scale, tot = quantize_hist_partial(x, gmax)
+                        qs = jax.lax.psum(q.astype(jnp.int32), "data")
+                        tots = jax.lax.psum(tot, "data")
+                        return dequantize_hist_sum(qs, scale, tots)
                     return jax.lax.psum(x, "data")
                 if dsize == 1:
                     return x
@@ -2181,20 +2364,39 @@ class HistGBT(_ExternalMemoryEngine):
                 rec_thr = rec_thr.at[hc_eff].set(tsel, mode="drop")
                 rec_gain = rec_gain.at[hc_eff].set(cand_gain[hc],
                                                    mode="drop")
-                # descend the expanded leaf's rows on (fsel, tsel)
-                v = row_bins_of(fsel)
-                go_right = v > tsel
                 mine = node == hc
-                node = jnp.where(ok & mine,
-                                 2 * node + go_right.astype(jnp.int32),
-                                 node)
-                # ONE build: left child only; right = parent − left
-                node_build = jnp.where(ok & mine & ~go_right, 0, -1)
-                left = build_one(node_build)[:, 0]        # [2, S, Bs]
                 slot = jnp.argmax(pool_id == hc)
-                right = pool[slot] - left
-                f2, t2, g2, tg2, th2 = eval_nodes(
-                    jnp.stack([left, right], axis=1))
+                if fused_rounds:
+                    # ONE Pallas program per expansion: descend the
+                    # leaf's rows, build the left child and subtract it
+                    # from the pooled parent histogram in VMEM; child
+                    # evaluation runs on the kernel's emitted pair
+                    node_in = jnp.where(ok & mine, 0, -1)
+                    nn, pair, sc2 = fused_round(
+                        bins_tl, node_in,
+                        jnp.full(node.shape, fsel, jnp.int32),
+                        jnp.full(node.shape, tsel, jnp.int32),
+                        g, h, pool[slot][:, None], 1, B,
+                        layout=layout, score_fn=eval_nodes)
+                    node = jnp.where(ok & mine,
+                                     2 * node + (nn == 1).astype(
+                                         jnp.int32), node)
+                    left = pair[:, 0]                     # [2, S, Bs]
+                    right = pair[:, 1]
+                    f2, t2, g2, tg2, th2 = sc2
+                else:
+                    # descend the expanded leaf's rows on (fsel, tsel)
+                    v = row_bins_of(fsel)
+                    go_right = v > tsel
+                    node = jnp.where(ok & mine,
+                                     2 * node + go_right.astype(jnp.int32),
+                                     node)
+                    # ONE build: left child only; right = parent − left
+                    node_build = jnp.where(ok & mine & ~go_right, 0, -1)
+                    left = build_one(node_build)[:, 0]    # [2, S, Bs]
+                    right = pool[slot] - left
+                    f2, t2, g2, tg2, th2 = eval_nodes(
+                        jnp.stack([left, right], axis=1))
                 # children at the depth cap never expand
                 g2 = jnp.where(levels[2 * hc] < depth, g2, -jnp.inf)
                 lc = jnp.where(ok, 2 * hc, NH)
