@@ -1436,6 +1436,771 @@ def _ps_bench() -> None:
         sys.stdout.flush()
 
 
+# ---------------------------------------------------------------------------
+# --prodsim: production-day simulation — whole-stack chaos drill
+# ---------------------------------------------------------------------------
+
+_PRODSIM_TENANTS = ["t0", "t1", "t2", "t3", "t4"]
+_PRODSIM_POISON = "t2"               # the tenant whose v2 publish is poisoned
+_PRODSIM_LIVE = "live"               # the stream-refreshed tenant
+_PRODSIM_HOSTS = ["p0", "p1", "p2", "p3", "p4", "p5"]
+
+
+def _prodsim_emit(rec, final=False):
+    rec = {"metric": "prodsim_availability", "unit": "ratio",
+           "provisional": not final, **rec}
+    if final:
+        _attach_metrics(rec)
+        _attach_slo(rec)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(rec) + "\n")
+        sys.stdout.flush()
+
+
+def _prodsim_ps_blocks(rank, n_features, rows, nnz=8):
+    """Deterministic per-worker CSR shard (32 shared signal features so
+    every shard is learnable) — the sparse-CTR lane's data."""
+    from dmlc_core_tpu.data.row_block import RowBlock
+
+    sig_rng = np.random.default_rng(7)
+    hot = sig_rng.choice(n_features, 32, replace=False)
+    w_true = sig_rng.normal(size=32).astype(np.float32)
+    rng = np.random.default_rng(100 + rank)
+    blocks = []
+    for _ in range(2):
+        n = rows // 2
+        idx = rng.integers(0, n_features, size=(n, nnz)).astype(np.int64)
+        idx[:, :4] = hot[rng.integers(0, 32, size=(n, 4))]
+        vals = rng.normal(size=(n, nnz)).astype(np.float32)
+        order = np.argsort(hot)
+        pos = order[np.searchsorted(hot[order], idx[:, :4])]
+        y = ((vals[:, :4] * w_true[pos]).sum(1) > 0).astype(np.float32)
+        off = np.arange(0, n * nnz + 1, nnz, dtype=np.int64)
+        blocks.append(RowBlock(offset=off, label=y, index=idx.ravel(),
+                               value=vals.ravel()))
+    return blocks
+
+
+def _prodsim_ps_server() -> None:
+    """Internal ``--prodsim-ps-server`` entry (spawned by --prodsim)."""
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.parallel.ps import PSServer
+
+    srv = PSServer("127.0.0.1", int(os.environ["PS_SCHED_PORT"]),
+                   server_id=int(os.environ["DMLC_PS_SERVER_ID"]))
+    srv.start()
+    srv.serve_forever(timeout_s=600)
+    out = os.environ.get("PS_SERVER_STATS")
+    if out:
+        with open(out, "w") as f:
+            json.dump({"server_id": srv.server_id,
+                       "restored_version": srv.restored_version}, f)
+    lockcheck.check()
+
+
+def _prodsim_ps_worker() -> None:
+    """Internal ``--prodsim-ps-worker`` entry: loop ``GBLinear.fit_ps``
+    passes until the stop file appears, so pushes span whatever chaos
+    the parent schedules; then score train accuracy on the own shard."""
+    from dmlc_core_tpu.base import lockcheck
+    from dmlc_core_tpu.models.linear import GBLinear
+    from dmlc_core_tpu.parallel.kvstore import DistAsyncKVStore
+    from dmlc_core_tpu.parallel.ps import PSClient
+
+    rank = int(os.environ["DMLC_TASK_ID"])
+    stop_file = os.environ["PRODSIM_PS_STOP"]
+    n_features = int(os.environ.get("PRODSIM_PS_FEATURES", "20000"))
+    client = PSClient(root_uri="127.0.0.1",
+                      root_port=int(os.environ["PS_SCHED_PORT"]), rank=rank)
+    kv = DistAsyncKVStore(client, learning_rate=0.5)
+    blocks = _prodsim_ps_blocks(
+        rank, n_features, int(os.environ.get("PRODSIM_PS_ROWS", "1200")))
+    model = None
+    passes = 0
+    while True:
+        model = GBLinear(learning_rate=0.5, reg_lambda=0.0)
+        model.fit_ps(blocks, kv, num_col=n_features, batch_rows=256,
+                     n_epochs=1)
+        passes += 1
+        if os.path.exists(stop_file):
+            break
+        # server-side init is first-wins (idempotent across workers),
+        # so dropping the client-side guard lets the next pass re-enter
+        # fit_ps and keep training the SAME fleet-resident weights
+        kv._shapes.pop("gblinear", None)
+    correct = total = 0
+    for blk in blocks:
+        rows = np.repeat(np.arange(blk.size), np.diff(blk.offset))
+        m = np.zeros(blk.size, np.float32)
+        np.add.at(m, rows, model.weights[blk.index] * blk.value)
+        m += model.bias
+        correct += int(((m > 0) == (blk.label > 0.5)).sum())
+        total += blk.size
+    samples = kv.staleness_samples
+    with open(os.path.join(os.environ["PS_OUT"],
+                           f"worker-{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "accuracy": correct / total,
+                   "passes": passes,
+                   "staleness_max": max(samples) if samples else 0}, f)
+    kv.close(shutdown_job=False)    # parent owns the scheduler
+    lockcheck.check()
+
+
+def _prodsim_bench() -> dict:
+    """``--prodsim``: one production day in one run — every tier faulted.
+
+    Composes everything the repo has grown into a single topology: a
+    live event feed streaming into an :class:`OnlineTrainer` whose
+    refreshes are published through tenant-scoped staged rollouts, a
+    sparse-CTR ``fit_ps`` lane on a real multi-process PS fleet, and a
+    multi-tenant replica fleet (FakeTransport "hosts" supervised by a
+    :class:`LauncherScaler` JobSet) serving diurnal Zipf loadgen —
+    while a deterministic chaos schedule (``DMLC_PRODSIM_CHAOS``, or a
+    default derived from ``DMLC_PRODSIM_SECONDS``; wall-clock
+    ``at=``/``every=`` triggers, seeded by ``DMLC_FAULT_SEED``) injects
+    one fault in every tier mid-run:
+
+    * ``prodsim_replica:kill``   — SIGKILL a serving replica
+    * ``prodsim_ps:kill``        — SIGKILL a PS server (respawned same
+      id, snapshot-restored)
+    * ``launch_host:wave``       — spot-preemption wave: 30% of fake
+      hosts down AT ONCE (fires inside the JobSet monitor tick)
+    * ``prodsim_shard:corrupt``  — corrupt bytes appended to the live
+      stream shard (tailer must resync)
+    * ``prodsim_publish:poison`` — poisoned v2 publish for ONE tenant
+      (eval gate must trip, rollback must stay tenant-scoped)
+
+    The final line is the one SLO scorecard record: availability,
+    dropped/wrong, per-tier chaos evidence, launch cause-fair respawn
+    budgets, PS restore, stream staleness + resyncs, and rollback
+    isolation.  Returns the record (``scripts/check_prodsim.py`` calls
+    this in-process and gates GREEN on ``scripts/slo/prodsim.json``)."""
+    t0 = time.time()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
+
+    import glob
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    from dmlc_core_tpu.base import faultinject
+    from dmlc_core_tpu.base import knobs as _knobs
+
+    duration = min(float(_knobs.value("DMLC_PRODSIM_SECONDS")),
+                   max(budget - 240, 6.0))
+    chaos_spec = str(_knobs.value("DMLC_PRODSIM_CHAOS")).strip()
+    if not chaos_spec:
+        # the default all-tier schedule scales with the load window
+        chaos_spec = ",".join([
+            f"prodsim_replica:kill:at={0.25 * duration:.3f}:n=1",
+            f"prodsim_ps:kill:at={0.35 * duration:.3f}:n=1",
+            f"launch_host:wave=0.3:at={0.5 * duration:.3f}:n=1",
+            f"prodsim_shard:corrupt:at={0.6 * duration:.3f}:n=1",
+            f"prodsim_publish:poison:at={0.7 * duration:.3f}:n=1",
+        ])
+    seed = int(os.environ.get("DMLC_FAULT_SEED") or "1234")
+    qps = float(os.environ.get("PRODSIM_QPS", 60))
+    rate = float(os.environ.get("PRODSIM_EVENTS_PER_SEC", 800))
+    feats = 8
+    n_rows = 400
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from dmlc_core_tpu.utils import force_cpu_devices
+        force_cpu_devices(int(os.environ["BENCH_FORCE_CPU"]))
+
+    cfg = {"duration_s": round(duration, 3), "qps": qps,
+           "tenants": len(_PRODSIM_TENANTS), "hosts": len(_PRODSIM_HOSTS),
+           "chaos_seed": seed}
+    _prodsim_emit({"value": 0.0, "phase": "setup", **cfg})
+
+    import jax  # noqa: F401 — device init before timing anything
+
+    from dmlc_core_tpu.base.metrics import default_registry
+    from dmlc_core_tpu.io.recordio import encode_records
+    from dmlc_core_tpu.launch.transport import FakeTransport
+    from dmlc_core_tpu.models import HistGBT
+    from dmlc_core_tpu.parallel.ps import PSScheduler
+    from dmlc_core_tpu.serve.client import ResilientClient
+    from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
+                                           HttpFleetAdmin, LauncherScaler,
+                                           Rollout, run_loadgen)
+    from dmlc_core_tpu.serve.registry import clone_model
+    from dmlc_core_tpu.serve.tenancy import (TenantPolicy,
+                                             checkpoint_tenant_model)
+    from dmlc_core_tpu.stream import (OnlineTrainer, RecordIOTailer,
+                                      encode_dense_events)
+
+    stale_hist = default_registry().histogram(
+        "stream_staleness_seconds",
+        "event appended → servable prediction (an activated version "
+        "has trained on it)",
+        buckets=(0.25, 0.5, 1, 2, 4, 8, 16, 32, 64))
+
+    # -- per-tenant v1 models, poisoned v2, and the live tenant's v1 -----
+    root = tempfile.mkdtemp(prefix="prodsim_")
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(n_rows, feats)).astype(np.float32)
+    models, npz = {}, {"X": X}
+    for i, t in enumerate(_PRODSIM_TENANTS):
+        y = (X[:, i % feats] + X[:, (i + 1) % feats]
+             * X[:, (i + 2) % feats] > 0).astype(np.float32)
+        m = HistGBT(n_trees=3 + i, max_depth=3, n_bins=16).fit(X, y)
+        models[t] = (m, y)
+        npz[f"{t}__v1"] = m.predict(X)
+        checkpoint_tenant_model(f"file://{root}/{t}_v1.ckpt", t, m,
+                                version=1)
+    y_poison = np.random.default_rng(7).permutation(
+        models[_PRODSIM_POISON][1])
+    m_poison = HistGBT(n_trees=4, max_depth=3, n_bins=16).fit(X, y_poison)
+    poison_uri = f"file://{root}/{_PRODSIM_POISON}_v2.ckpt"
+    checkpoint_tenant_model(poison_uri, _PRODSIM_POISON, m_poison,
+                            version=2)
+    npz[f"{_PRODSIM_POISON}__v2"] = m_poison.predict(X)
+    expected_npz = os.path.join(root, "expected.npz")
+    np.savez(expected_npz, **npz)
+    X_hold, y_hold = X[:64], models[_PRODSIM_POISON][1][:64]
+    base_mse = float(np.mean(
+        (models[_PRODSIM_POISON][0].predict(X_hold) - y_hold) ** 2))
+
+    # the live (stream-refreshed) tenant never appears in the loadgen
+    # mix; its oracle is a direct bit-equality probe after each rollout
+    ev_rng = np.random.default_rng(13)
+
+    def _make_events(gen, n, drift=0.0):
+        Xe = gen.normal(size=(n, feats)).astype(np.float32)
+        ye = (Xe[:, 0] * Xe[:, 1]
+              + (0.5 + drift) * Xe[:, 2] > 0).astype(np.float32)
+        return Xe, ye
+
+    X_live, y_live = _make_events(np.random.default_rng(5), 256)
+    m_live = HistGBT(n_trees=3, max_depth=3, n_bins=16,
+                     learning_rate=0.3).fit(X_live, y_live)
+    live_v1_uri = f"file://{root}/live_v1.ckpt"
+    checkpoint_tenant_model(live_v1_uri, _PRODSIM_LIVE, m_live, version=1)
+
+    # -- fleet: tracker + fake 6-host cluster + launcher-backed scaler ---
+    _prodsim_emit({"value": 0.0, "phase": "spawn", **cfg})
+    child_env = {"JAX_PLATFORMS": "cpu", "DMLC_TPU_FORCE_CPU": "1",
+                 "FLEET_TENANCY": "1", "DMLC_FAULT_INJECT": ""}
+    tracker = FleetTracker(nworker=16)
+    tracker.start()
+    transport = FakeTransport(hosts=list(_PRODSIM_HOSTS),
+                              log_dir=os.path.join(root, "logs"))
+    scaler = LauncherScaler(tracker, None, name="prodsim",
+                            transport=transport, initial=3,
+                            spawn_env=child_env, restart_limit=3)
+
+    # -- shared state for the lanes --------------------------------------
+    stop_gen = threading.Event()
+    stop_stream = threading.Event()
+    stop_chaos = threading.Event()
+    stop_recon = threading.Event()
+    live_lock = threading.Lock()
+    live_state = {"version": 1, "activated": 1, "model": m_live,
+                  "uri": live_v1_uri, "served_floor": 0}
+    append_ts = []
+    staleness = []
+    refreshes = []
+    live_rollouts = []
+    chaos_log = []
+    poison_report = {}
+    ps_state = {}
+    shard_dir = os.path.join(root, "events")
+    os.makedirs(shard_dir)
+    shard_events = 2048
+
+    def _generator():
+        written = 0
+        shard_idx = 0
+        f = open(os.path.join(shard_dir, f"part-{shard_idx:04d}.rec"), "ab")
+        start = time.perf_counter()
+        try:
+            while not stop_gen.is_set():
+                target = int((time.perf_counter() - start) * rate)
+                burst = min(target - written, 2048)
+                if burst <= 0:
+                    time.sleep(0.01)
+                    continue
+                Xe, ye = _make_events(
+                    ev_rng, burst, drift=0.2 * ((written // shard_events)
+                                                % 3))
+                f.write(encode_records(encode_dense_events(Xe, ye)))
+                f.flush()
+                now = time.time()
+                append_ts.extend([now] * burst)
+                written += burst
+                if written // shard_events > shard_idx:
+                    f.close()
+                    shard_idx = written // shard_events
+                    f = open(os.path.join(
+                        shard_dir, f"part-{shard_idx:04d}.rec"), "ab")
+        finally:
+            f.close()
+
+    tailer = RecordIOTailer(shard_dir,
+                            cursor_uri=os.path.join(root, "cursor.ckpt"),
+                            name="prodsim")
+    live_model = HistGBT(n_trees=2, max_depth=3, n_bins=16,
+                         learning_rate=0.3)
+    trainer = OnlineTrainer(live_model, tailer, n_features=feats,
+                            chunk_rows=512, window_chunks=2, decay=1.0,
+                            name="prodsim")
+
+    def _stream_lane():
+        # tail → warm-start boost → tenant-scoped staged rollout; an
+        # infra rollback (replica died mid-wave) is recorded and retried
+        # by the next refresh — only a gate trip is a real rollback
+        while not stop_stream.is_set():
+            try:
+                r = trainer.refresh(timeout=1.0, stop=stop_stream.is_set)
+            except Exception as e:  # noqa: BLE001
+                chaos_log.append({
+                    "t": round(time.time() - t0, 3), "point": "stream",
+                    "detail": f"refresh ERROR {type(e).__name__}: "
+                              f"{e}"[:200]})
+                time.sleep(0.2)
+                continue
+            if r is None:
+                continue
+            refreshes.append(r)
+            with live_lock:
+                version = live_state["version"] + 1
+                live_state["version"] = version
+            uri = f"file://{root}/live_v{version}.ckpt"
+            snap = clone_model(live_model)
+            checkpoint_tenant_model(uri, _PRODSIM_LIVE, snap,
+                                    version=version)
+            try:
+                admin = HttpFleetAdmin(dict(tracker.serve_endpoints()))
+                rep = Rollout(admin, wave_size=1, settle_s=0.1,
+                              tenant=_PRODSIM_LIVE).run(uri)
+            except Exception as e:  # noqa: BLE001
+                live_rollouts.append({
+                    "version": version,
+                    "outcome": f"error: {type(e).__name__}"})
+                continue
+            live_rollouts.append({"version": version,
+                                  "outcome": rep.get("outcome"),
+                                  "waves": rep.get("waves")})
+            if rep.get("outcome") != "activated":
+                continue
+            with live_lock:
+                live_state.update(activated=version, model=snap, uri=uri)
+                floor = live_state["served_floor"]
+            now = time.time()
+            covered = min(r["records_total"], len(append_ts))
+            for seq in range(floor, covered):
+                s = now - append_ts[seq]
+                staleness.append(s)
+                stale_hist.observe(s)
+            with live_lock:
+                live_state["served_floor"] = covered
+
+    def _reconciler():
+        # heal freshly-respawned replicas: any tenant missing from a
+        # health doc is (re)loaded at its current good version — never
+        # fights a rollout, which only moves tenants that ARE present
+        all_tenants = _PRODSIM_TENANTS + [_PRODSIM_LIVE]
+        while not stop_recon.is_set():
+            try:
+                eps = dict(tracker.serve_endpoints())
+                admin = HttpFleetAdmin(eps)
+                for rank in eps:
+                    try:
+                        tdoc = admin.health(rank).get("tenants", {})
+                    except Exception:  # noqa: BLE001 — mid-respawn
+                        continue
+                    for t in all_tenants:
+                        if t in tdoc:
+                            continue
+                        if t == _PRODSIM_LIVE:
+                            with live_lock:
+                                uri = live_state["uri"]
+                        else:
+                            uri = f"file://{root}/{t}_v1.ckpt"
+                        try:
+                            admin.load(rank, uri, activate=True, tenant=t)
+                        except Exception:  # noqa: BLE001
+                            pass
+            except Exception:  # noqa: BLE001
+                pass
+            stop_recon.wait(0.4)
+
+    # -- PS lane: scheduler in-parent, 2 servers + 2 workers as procs ----
+    ps_dir = os.path.join(root, "ps")
+    snap_dir = os.path.join(ps_dir, "snap")
+    os.makedirs(snap_dir)
+    ps_stop_file = os.path.join(ps_dir, "stop")
+    sched = PSScheduler("127.0.0.1", nworker=2, nserver=2)
+    sched.start()
+
+    def _launch_ps(role, server_id=-1, rank=-1, stats=""):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   DMLC_TPU_FORCE_CPU="1",
+                   DMLC_FAULT_INJECT="",
+                   DMLC_PS_SNAPSHOT_DIR=snap_dir,
+                   DMLC_PS_SNAPSHOT_STRIDE="1",
+                   DMLC_PS_RECONNECT_S="120",
+                   DMLC_PS_SERVER_ID=str(server_id),
+                   DMLC_TASK_ID=str(rank),
+                   PS_SCHED_PORT=str(sched.port),
+                   PS_OUT=ps_dir,
+                   PS_SERVER_STATS=stats,
+                   PRODSIM_PS_STOP=ps_stop_file)
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             f"--prodsim-ps-{role}"], env=env)
+
+    ps_servers = [_launch_ps("server", server_id=i) for i in range(2)]
+    ps_workers = [_launch_ps("worker", rank=r) for r in range(2)]
+    ps_state["respawn_stats"] = os.path.join(ps_dir, "respawn.json")
+
+    # -- chaos actions (one per tier; launch_host fires in the JobSet
+    # monitor tick, inside FakeTransport) --------------------------------
+    def _fault_replica(fault):
+        st = scaler.jobset.stats()
+        live = sorted(r for r, d in st["ranks"].items() if not d["done"])
+        if not live:
+            return "no live rank"
+        scaler.jobset.kill(live[0], sig=_signal.SIGKILL, respawn=True)
+        return f"SIGKILL replica rank {live[0]}"
+
+    def _fault_ps(fault):
+        victim = ps_servers[1]
+        victim.send_signal(_signal.SIGKILL)
+        victim.wait(timeout=60)
+        ps_state["victim_rc"] = victim.returncode
+        ps_servers[1] = _launch_ps("server", server_id=1,
+                                   stats=ps_state["respawn_stats"])
+        return (f"SIGKILL ps server 1 (rc={victim.returncode}); "
+                "respawned same id")
+
+    def _fault_shard(fault):
+        # smash 64 bytes at the tailer's OWN read position: consumed
+        # offsets always sit on record boundaries, so the very next
+        # poll sees non-magic where a record must start and has to
+        # resync forward — corrupting the newest shard instead would
+        # sit unread until the (slower) trainer caught up to it
+        shards = sorted(glob.glob(os.path.join(shard_dir, "part-*.rec")))
+        offs = dict(tailer.cursor().offsets)
+        target, off = shards[-1], 0
+        for path in shards:
+            done = offs.get(path, 0)
+            if done < os.path.getsize(path):
+                target, off = path, done
+                break
+        with open(target, "r+b") as f:
+            f.seek(off)
+            f.write(b"\x00" * 64)    # no magic, keeps 4-byte alignment
+        return (f"smashed 64 bytes at {os.path.basename(target)}"
+                f"+{off} (tailer cursor)")
+
+    def _poison_gate(admin, endpoints):
+        def gate(version):
+            # honest gate: score the holdout against each replica that
+            # actually serves the candidate version for the tenant
+            for rank, url in endpoints.items():
+                try:
+                    tdoc = admin.health(rank).get("tenants", {}).get(
+                        _PRODSIM_POISON, {})
+                    if tdoc.get("version") != version:
+                        continue
+                    p, v = ResilientClient(url).predict(
+                        X_hold, tenant=_PRODSIM_POISON)
+                except Exception:  # noqa: BLE001 — replica mid-churn
+                    continue
+                if v != version:
+                    continue
+                mse = float(np.mean((p - y_hold) ** 2))
+                if mse > 2.0 * base_mse + 1e-6:
+                    return False
+            return True
+        return gate
+
+    def _fault_publish(fault):
+        endpoints = dict(tracker.serve_endpoints())
+        admin = HttpFleetAdmin(endpoints)
+        rep = Rollout(admin, wave_size=1, settle_s=0.3,
+                      eval_gate=_poison_gate(admin, endpoints),
+                      tenant=_PRODSIM_POISON).run(poison_uri)
+        poison_report.update(rep)
+        return f"poisoned publish outcome={rep.get('outcome')}"
+
+    def _chaos_driver():
+        actions = (("prodsim_replica", _fault_replica),
+                   ("prodsim_ps", _fault_ps),
+                   ("prodsim_shard", _fault_shard),
+                   ("prodsim_publish", _fault_publish))
+        while not stop_chaos.is_set():
+            for point, action in actions:
+                fault = faultinject.check(point)
+                if fault is None:
+                    continue
+                try:
+                    detail = action(fault)
+                except Exception as e:  # noqa: BLE001
+                    detail = f"ERROR {type(e).__name__}: {e}"[:200]
+                chaos_log.append({"t": round(time.time() - t0, 3),
+                                  "point": point, "kind": fault.kind,
+                                  "detail": detail})
+            stop_chaos.wait(0.05)
+
+    router = None
+    merged = {}
+    chaos_fired = {}
+    chaos_rules = []
+    try:
+        deadline = time.time() + 180
+        while len(tracker.serve_endpoints()) < 3:
+            if time.time() > deadline:
+                raise RuntimeError("prodsim replicas never registered")
+            time.sleep(0.2)
+        endpoints = dict(tracker.serve_endpoints())
+        admin = HttpFleetAdmin(endpoints)
+        for rank in endpoints:
+            for t in _PRODSIM_TENANTS:
+                admin.load(rank, f"file://{root}/{t}_v1.ckpt",
+                           activate=True, tenant=t)
+            admin.load(rank, live_v1_uri, activate=True,
+                       tenant=_PRODSIM_LIVE)
+        policy = TenantPolicy(classes="gold:t0;bronze:t4",
+                              default_class="silver", quota=0,
+                              max_inflight=256, shed_fraction=0.5,
+                              hedge_ms=0)
+        router = FleetRouter(tracker, probe_s=0.2, policy=policy).start()
+        probe, ver = ResilientClient(router.url).predict(X[:8],
+                                                         tenant="t1")
+        if ver != 1 or not np.array_equal(probe, npz["t1__v1"][:8]):
+            raise RuntimeError("prodsim: routed warmup predict mismatch")
+
+        gen_t = threading.Thread(target=_generator, daemon=True,
+                                 name="prodsim-gen")
+        lane_t = threading.Thread(target=_stream_lane, daemon=True,
+                                  name="prodsim-stream")
+        recon_t = threading.Thread(target=_reconciler, daemon=True,
+                                   name="prodsim-recon")
+        gen_t.start()
+        lane_t.start()
+        recon_t.start()
+
+        _prodsim_emit({"value": 0.0, "phase": "load", **cfg})
+        with faultinject.inject(chaos_spec, seed=seed):
+            chaos_t = threading.Thread(target=_chaos_driver, daemon=True,
+                                       name="prodsim-chaos")
+            chaos_t.start()
+            merged = run_loadgen(
+                router.url, expected_npz, duration_s=duration, procs=2,
+                threads=3, base_qps=qps, amplitude=0.5,
+                period_s=max(duration / 2.0, 2.0), timeout_ms=20_000,
+                workdir=root, env=child_env,
+                tenants=list(_PRODSIM_TENANTS))
+            # let straggler rules (and the wave, which fires in the
+            # supervisor tick) finish before tearing the schedule down
+            fire_deadline = time.time() + max(duration, 10.0)
+            while time.time() < fire_deadline:
+                if all(r["fires"] >= 1 for r in faultinject.rules()):
+                    break
+                time.sleep(0.2)
+            stop_chaos.set()
+            chaos_t.join(timeout=90)
+            chaos_fired = faultinject.stats()
+            chaos_rules = faultinject.rules()
+        wave_hosts = transport.down_hosts()
+
+        stop_gen.set()
+        gen_t.join(timeout=10)
+        stop_stream.set()
+        lane_t.join(timeout=120)
+
+        # live-tenant oracle: the routed answer must be bit-identical to
+        # the snapshot of the last ACTIVATED refresh (reconciler still
+        # healing respawned replicas, so allow convergence time)
+        with live_lock:
+            want_ver = live_state["activated"]
+            want_model = live_state["model"]
+        want_pred = want_model.predict(X_live[:32])
+        live_ok = 0
+        client = ResilientClient(router.url)
+        probe_deadline = time.time() + 60
+        while time.time() < probe_deadline:
+            try:
+                p, v = client.predict(X_live[:32], tenant=_PRODSIM_LIVE)
+                if v == want_ver and np.array_equal(p, want_pred):
+                    live_ok = 1
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+
+        # rollback isolation: every static tenant on every replica back
+        # on v1 — the poisoned v2 stuck nowhere
+        isolated = 0
+        iso_deadline = time.time() + 60
+        while time.time() < iso_deadline:
+            try:
+                eps = dict(tracker.serve_endpoints())
+                admin = HttpFleetAdmin(eps)
+                if eps and all(
+                        admin.health(rank).get("tenants", {})
+                        .get(t, {}).get("version") == 1
+                        for rank in eps for t in _PRODSIM_TENANTS):
+                    isolated = 1
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        stop_recon.set()
+        recon_t.join(timeout=10)
+
+        # drain the PS lane: stop file → workers finish the pass and
+        # exit; job completion lets the servers write stats and exit
+        with open(ps_stop_file, "w") as f:
+            f.write("stop\n")
+        ps_rcs = {"workers": [], "servers": []}
+        ps_deadline = time.time() + 180
+        for p in ps_workers + ps_servers:
+            try:
+                p.wait(timeout=max(1.0, ps_deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        ps_rcs["workers"] = [p.returncode for p in ps_workers]
+        ps_rcs["servers"] = [p.returncode for p in ps_servers]
+        worker_stats = {}
+        for r in range(2):
+            path = os.path.join(ps_dir, f"worker-{r}.json")
+            if os.path.exists(path):
+                with open(path) as f:
+                    worker_stats[r] = json.load(f)
+        respawn = None
+        if os.path.exists(ps_state["respawn_stats"]):
+            with open(ps_state["respawn_stats"]) as f:
+                respawn = json.load(f)
+
+        st = scaler.jobset.stats()
+        giveups = sum(1 for e in scaler.jobset.events()
+                      if e.get("event") == "giveup")
+        static_rb = 0.0
+        snap = default_registry().snapshot()["metrics"]
+        for s in snap.get("dmlc_tenant_rollbacks_total",
+                          {}).get("series", []):
+            tlabel = s["labels"].get("tenant")
+            if tlabel in _PRODSIM_TENANTS and tlabel != _PRODSIM_POISON:
+                static_rb += s["value"]
+
+        stale_sorted = sorted(staleness)
+
+        def q(p):
+            if not stale_sorted:
+                return None
+            return round(stale_sorted[min(len(stale_sorted) - 1,
+                                          int(round(p * (len(stale_sorted)
+                                                         - 1))))], 3)
+
+        tiers = {
+            "replica": int(any(l.get("point") == "prodsim_replica"
+                               for l in chaos_log)),
+            "ps": int(any(l.get("point") == "prodsim_ps"
+                          for l in chaos_log)),
+            "host": int(chaos_fired.get("launch_host:wave", 0) >= 1),
+            "shard": int(any(l.get("point") == "prodsim_shard"
+                             for l in chaos_log)),
+            "publish": int(any(l.get("point") == "prodsim_publish"
+                               for l in chaos_log)),
+        }
+        availability = merged.get("ok", 0) / max(merged.get("count", 0), 1)
+
+        rec = {
+            "value": round(availability, 5),
+            "phase": "done",
+            "elapsed_s": round(time.time() - t0, 1),
+            "platform": jax.devices()[0].platform,
+            "availability": round(availability, 5),
+            "dropped": merged.get("dropped"),
+            "wrong": merged.get("wrong"),
+            "loadgen": {k: merged.get(k) for k in
+                        ("count", "ok", "dropped", "wrong", "shed",
+                         "throughput_rps", "latency_p50_ms",
+                         "latency_p95_ms", "latency_p99_ms",
+                         "by_tenant")},
+            "chaos": {
+                "schedule": chaos_spec,
+                "seed": seed,
+                "fired": chaos_fired,
+                "rules": chaos_rules,
+                "tiers": tiers,
+                "tiers_faulted": int(sum(tiers.values())),
+                "wave_hosts": wave_hosts,
+                "log": chaos_log,
+            },
+            "launch": {
+                "backend": st["backend"],
+                "respawns": st["respawns"],
+                "respawns_by_cause": st["respawns_by_cause"],
+                "host_faults": st["host_faults"],
+                "spawn_ms_p95": st["spawn_ms_p95"],
+                "giveups": giveups,
+            },
+            "ps": {
+                "victim_rc": ps_state.get("victim_rc"),
+                "victim_sigkilled": int(ps_state.get("victim_rc")
+                                        == -_signal.SIGKILL),
+                "respawn": respawn,
+                "restored_version": (respawn or {}).get(
+                    "restored_version"),
+                "workers": worker_stats,
+                "min_accuracy": (min(w["accuracy"]
+                                     for w in worker_stats.values())
+                                 if worker_stats else None),
+                "rcs": ps_rcs,
+            },
+            "stream": {
+                "refreshes": len(refreshes),
+                "rollouts": live_rollouts,
+                "activated": sum(1 for lr in live_rollouts
+                                 if lr.get("outcome") == "activated"),
+                "staleness_seconds": {"p50": q(0.50), "p95": q(0.95),
+                                      "p99": q(0.99)},
+                "resyncs": tailer.resyncs,
+                "events_appended": len(append_ts),
+                "events_consumed": tailer.records_seen,
+                "live_version": want_ver,
+                "live_verified": live_ok,
+            },
+            "rollback": {
+                "poisoned": int(poison_report.get("outcome")
+                                == "rolled_back"),
+                "poison_waves": poison_report.get("waves"),
+                "static_rollbacks": static_rb,
+                "isolated": isolated,
+            },
+            **cfg,
+        }
+        _prodsim_emit(rec, final=True)
+        return rec
+    finally:
+        stop_gen.set()
+        stop_stream.set()
+        stop_chaos.set()
+        stop_recon.set()
+        if router is not None:
+            router.close()
+        try:
+            tailer.close()
+        except Exception:  # noqa: BLE001
+            pass
+        scaler.reap(timeout=15)
+        tracker.stop()
+        transport.close()
+        for p in ps_workers + ps_servers:
+            if p.poll() is None:
+                p.kill()
+        try:
+            sched.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def main() -> None:
     EV["t0"] = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 480))
@@ -1811,6 +2576,16 @@ if __name__ == "__main__":
     # (no-op otherwise) so the bench parent's registry merges with any
     # spawned replicas'/workers' under one DMLC_METRICS_SPOOL directory
     from dmlc_core_tpu.base.metrics_agg import install_spool
+    if "--prodsim-ps-server" in sys.argv:
+        install_spool("prodsim_ps_server",
+                      int(os.environ.get("DMLC_PS_SERVER_ID", "0")))
+        _prodsim_ps_server()
+        sys.exit(0)
+    if "--prodsim-ps-worker" in sys.argv:
+        install_spool("prodsim_ps_worker",
+                      int(os.environ.get("DMLC_TASK_ID", "0")))
+        _prodsim_ps_worker()
+        sys.exit(0)
     install_spool("bench", 0)
     if "--serve" in sys.argv:
         _serve_bench()
@@ -1822,6 +2597,8 @@ if __name__ == "__main__":
         _stream_bench()
     elif "--ps" in sys.argv:
         _ps_bench()
+    elif "--prodsim" in sys.argv:
+        _prodsim_bench()
     elif "--scaling-probe" in sys.argv:
         _scaling_probe()
     else:

@@ -15,6 +15,10 @@ Spec grammar (``DMLC_FAULT_INJECT`` or :class:`inject`)::
     opt   := "p=" float        # fire probability per check (default 1)
            | "n=" int          # max fires for this rule (default unlimited)
            | "after=" int      # skip the first k checks (default 0)
+           | "at=" float       # eligible once >= at seconds have elapsed
+           | "every=" float    # wave trigger: at most one draw per
+                               #   every-second wave (wave k spans
+                               #   [at + k*every, at + (k+1)*every))
 
 Examples::
 
@@ -22,7 +26,21 @@ Examples::
     DMLC_FAULT_INJECT="checkpoint:kill:after=1"   # 2nd checkpoint dies
     DMLC_FAULT_INJECT="worker:kill:after=7"       # SIGKILL at round 8
     DMLC_FAULT_INJECT="allreduce:abort:after=30"  # void the round
+    DMLC_FAULT_INJECT="prodsim_replica:kill:at=5:n=1"   # T+5s, once
+    DMLC_FAULT_INJECT="launch_host:wave=0.3:at=10:n=1"  # spot wave T+10s
     with faultinject.inject("serve:error=503:p=0.5:n=20"): ...
+
+Wall-clock triggers (the **chaos scheduler**): ``at=`` makes a rule
+eligible only once the schedule clock has advanced past that many
+seconds since :func:`configure` (re)anchored the epoch; ``every=``
+partitions elapsed time into waves and allows at most ONE probability
+draw per wave, so ``launch_host:wave=0.3:every=30:p=0.5`` models a
+spot-preemption front that may (seed-deterministically) sweep the
+cluster every 30 s.  The schedule clock defaults to
+``time.monotonic`` and is injectable via :func:`set_clock`, so tests
+drive waves with a fake clock and the whole schedule — which waves
+fire, in which order — is a pure function of (spec, seed, clock),
+asserted in ``tests/test_resilience.py``.
 
 Kinds are interpreted by the injection SITE (the injector only decides
 *whether* to fire): ``error=<status>`` fabricates an HTTP failure,
@@ -50,12 +68,13 @@ from __future__ import annotations
 import os
 import random
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 from dmlc_core_tpu.base import metrics as _metrics
 
 __all__ = ["Fault", "check", "configure", "inject", "active",
-           "fired_total", "stats"]
+           "fired_total", "stats", "rules", "set_clock"]
 
 _ENV_SPEC = "DMLC_FAULT_INJECT"
 _ENV_SEED = "DMLC_FAULT_SEED"
@@ -87,16 +106,21 @@ class Fault:
 
 class _Rule:
     __slots__ = ("point", "kind", "value", "p", "n", "after",
-                 "checked", "fires", "rng")
+                 "at", "every", "last_wave", "checked", "fires", "rng")
 
     def __init__(self, point: str, kind: str, value: Optional[str],
-                 p: float, n: Optional[int], after: int, seed: int):
+                 p: float, n: Optional[int], after: int, seed: int,
+                 at: Optional[float] = None,
+                 every: Optional[float] = None):
         self.point = point
         self.kind = kind
         self.value = value
         self.p = p
         self.n = n
         self.after = after
+        self.at = at
+        self.every = every
+        self.last_wave = -1       # highest wave index already drawn for
         self.checked = 0
         self.fires = 0
         self.rng = random.Random(seed)
@@ -114,7 +138,7 @@ def _parse(spec: str, seed: int) -> List[_Rule]:
         kind, value = fields[1], None
         if "=" in kind:
             kind, value = kind.split("=", 1)
-        p, n, after = 1.0, None, 0
+        p, n, after, at, every = 1.0, None, 0, None, None
         for opt in fields[2:]:
             k, _, v = opt.partition("=")
             if k == "p":
@@ -123,11 +147,21 @@ def _parse(spec: str, seed: int) -> List[_Rule]:
                 n = int(v)
             elif k == "after":
                 after = int(v)
+            elif k == "at":
+                at = float(v)
+                if at < 0:
+                    raise ValueError(
+                        f"fault spec rule {raw!r}: at= must be >= 0")
+            elif k == "every":
+                every = float(v)
+                if every <= 0:
+                    raise ValueError(
+                        f"fault spec rule {raw!r}: every= must be > 0")
             else:
                 raise ValueError(
                     f"fault spec rule {raw!r}: unknown option {opt!r}")
         rules.append(_Rule(point, kind, value, p, n, after,
-                           seed=seed * 1000003 + idx))
+                           seed=seed * 1000003 + idx, at=at, every=every))
     return rules
 
 
@@ -135,7 +169,18 @@ _LOCK = threading.Lock()
 _RULES: List[_Rule] = []
 _CONFIGURED_SPEC: Optional[str] = None  # spec the rules were parsed from
 _PINNED = 0                             # >0: inject() overrides the env
+_CLOCK: Callable[[], float] = time.monotonic  # schedule clock (injectable)
+_EPOCH = 0.0                            # clock value when configure() ran
 _FM = None
+
+
+def set_clock(clock: Optional[Callable[[], float]] = None) -> None:
+    """Install the schedule clock ``at=``/``every=`` rules are timed
+    against (``None`` restores ``time.monotonic``).  Call *before*
+    :func:`configure`/:class:`inject` — the epoch is anchored there."""
+    global _CLOCK
+    with _LOCK:
+        _CLOCK = clock if clock is not None else time.monotonic
 
 
 def _fi_metrics():
@@ -152,6 +197,7 @@ def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
     """(Re)parse the fault spec — ``None`` reads ``DMLC_FAULT_INJECT`` /
     ``DMLC_FAULT_SEED``.  Resets per-rule counters and RNG streams."""
     global _RULES, _CONFIGURED_SPEC
+    global _EPOCH
     spec = os.environ.get(_ENV_SPEC, "") if spec is None else spec
     if seed is None:
         try:
@@ -161,6 +207,7 @@ def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
     with _LOCK:
         _RULES = _parse(spec, seed) if spec else []
         _CONFIGURED_SPEC = spec
+        _EPOCH = _CLOCK()       # anchor the at=/every= schedule epoch
 
 
 def _ensure_current() -> None:
@@ -187,6 +234,7 @@ def check(point: str, ctx: str = "") -> Optional[Fault]:
     if not _RULES:
         return None
     with _LOCK:
+        elapsed = None          # schedule clock read at most once/check
         for rule in _RULES:
             if rule.point != point:
                 continue
@@ -195,6 +243,18 @@ def check(point: str, ctx: str = "") -> Optional[Fault]:
                 continue
             if rule.n is not None and rule.fires >= rule.n:
                 continue
+            if rule.at is not None or rule.every is not None:
+                if elapsed is None:
+                    elapsed = _CLOCK() - _EPOCH
+                if rule.at is not None and elapsed < rule.at:
+                    continue
+                if rule.every is not None:
+                    wave = int((elapsed - (rule.at or 0.0)) // rule.every)
+                    if wave <= rule.last_wave:
+                        continue
+                    # one probability draw per wave, hit or miss — the
+                    # fired-wave set is then a pure function of the seed
+                    rule.last_wave = wave
             if rule.p < 1.0 and rule.rng.random() >= rule.p:
                 continue
             rule.fires += 1
@@ -230,23 +290,26 @@ class inject:
         self._seed = seed
         self._saved: Optional[List[_Rule]] = None
         self._saved_spec: Optional[str] = None
+        self._saved_epoch = 0.0
 
     def __enter__(self) -> "inject":
         global _PINNED
         with _LOCK:
             self._saved = _RULES
             self._saved_spec = _CONFIGURED_SPEC
+            self._saved_epoch = _EPOCH
         configure(self._spec, self._seed)
         with _LOCK:
             _PINNED += 1
         return self
 
     def __exit__(self, *exc: Any) -> None:
-        global _PINNED, _RULES, _CONFIGURED_SPEC
+        global _PINNED, _RULES, _CONFIGURED_SPEC, _EPOCH
         with _LOCK:
             _PINNED -= 1
             _RULES = self._saved or []
             _CONFIGURED_SPEC = self._saved_spec
+            _EPOCH = self._saved_epoch
 
 
 def stats() -> Dict[str, int]:
@@ -257,3 +320,15 @@ def stats() -> Dict[str, int]:
             key = f"{r.point}:{r.kind}"
             out[key] = out.get(key, 0) + r.fires
         return out
+
+
+def rules() -> List[Dict[str, Any]]:
+    """Full per-rule view — parsed grammar fields plus live counters —
+    in spec order.  The chaos drills assert the schedule round-trips
+    (grammar in == rules out) and that every scheduled rule fired."""
+    with _LOCK:
+        return [{"point": r.point, "kind": r.kind, "value": r.value,
+                 "p": r.p, "n": r.n, "after": r.after, "at": r.at,
+                 "every": r.every, "checked": r.checked,
+                 "fires": r.fires, "last_wave": r.last_wave}
+                for r in _RULES]

@@ -277,10 +277,19 @@ declare("DMLC_CKPT_KEEP", "",
         "chain); empty = 1.", "resilience")
 declare("DMLC_FAULT_INJECT", "",
         "Deterministic fault-injection spec "
-        "('point:kind[=v][:p=][:n=][:after=];...'); empty "
-        "disables.", "resilience")
+        "('point:kind[=v][:p=][:n=][:after=][:at=][:every=],...'); "
+        "empty disables.", "resilience")
 declare("DMLC_FAULT_SEED", 1234,
         "Seed for the per-rule fault-injection RNG streams.", "resilience")
+declare("DMLC_PRODSIM_SECONDS", 24.0,
+        "Duration of the bench.py --prodsim production-day simulation "
+        "load window in seconds (the chaos schedule scales with it).",
+        "resilience")
+declare("DMLC_PRODSIM_CHAOS", "",
+        "Override chaos schedule for bench.py --prodsim (faultinject "
+        "grammar with at=/every= wall-clock triggers); empty derives "
+        "the default all-tier schedule from DMLC_PRODSIM_SECONDS.",
+        "resilience")
 declare("DMLC_RECOVERY_STRIDE", 5,
         "Boosting rounds between round-versioned collective checkpoint "
         "commits (the elastic-recovery floor granularity).", "resilience")

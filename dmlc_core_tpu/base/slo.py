@@ -24,17 +24,19 @@ Spec format (JSON)::
                              {"evidence": "loadgen.requests"}]}}]}
 
 A ``source`` is one of: a **metric selector** (metric name + label
-filter + stat: ``sum``/``value``/``count``/``min``/``max``/``p50``/
-``p90``/``p99``, with optional ``scale``), an **evidence pointer**
-(dotted path into the caller-supplied evidence dict), or a ``ratio`` of
-two sources.  Counter/sum-like stats treat an absent series as 0 (a
-never-incremented error counter IS zero errors); quantiles over no data
-are ``None`` and fail the objective.
+filter + stat: ``sum``/``value``/``count``/``min``/``max`` or any
+histogram quantile ``p<nn>`` — ``p50``, ``p90``, ``p95``, ``p99``, … —
+with optional ``scale``), an **evidence pointer** (dotted path into the
+caller-supplied evidence dict), or a ``ratio`` of two sources.
+Counter/sum-like stats treat an absent series as 0 (a never-incremented
+error counter IS zero errors); quantiles over no data are ``None`` and
+fail the objective.
 """
 
 from __future__ import annotations
 
 import json
+import re
 from typing import Any, Dict, List, Optional, Sequence
 
 __all__ = ["SLOSpec", "evaluate"]
@@ -49,6 +51,9 @@ _OPS = {
 
 #: stats where "no matching series" legitimately means zero
 _ZERO_WHEN_MISSING = {"sum", "value", "count"}
+
+#: any histogram quantile selector: p50, p90, p95, p99, ...
+_QUANTILE_STAT = re.compile(r"p([0-9]{1,2})$")
 
 
 class SLOSpec:
@@ -148,11 +153,12 @@ def _resolve_metric(src: Dict[str, Any],
         if stat == "max":
             vals = [s["max"] for s in series if s.get("max") is not None]
             return max(vals) * scale if vals else None
-        if stat in ("p50", "p90", "p99"):
+        m = _QUANTILE_STAT.match(stat)
+        if m:
             pool: List[float] = []
             for s in series:
                 pool.extend(s.get("reservoir", ()))
-            q = _quantile(pool, int(stat[1:]) / 100.0)
+            q = _quantile(pool, int(m.group(1)) / 100.0)
             return q * scale if q is not None else None
         return None
     # counter / gauge

@@ -19,7 +19,14 @@ just *starting* N ranked workers but owning their lifecycle:
   a per-rank ``DMLC_LAUNCH_RESTART_LIMIT`` budget; placement re-runs
   against the *currently live* hosts, so a dead host's ranks land on
   survivors.  ``DMLC_NUM_ATTEMPT`` counts up so the worker (and the
-  tracker's ``recover`` path) knows it is a replacement.
+  tracker's ``recover`` path) knows it is a replacement.  The budget is
+  **cause-fair**: the transport's ``classify_exit`` attributes each
+  exit, and only a ``crash`` (the rank's own fault) spends the rank's
+  budget — a ``host_death`` (spot preemption, SSH connect failure)
+  charges the host's fault map instead, so a rank preempted twice by a
+  spot wave keeps its full restart budget for a genuine crash.
+  ``events()`` carries the cause per exit; ``stats()`` breaks respawns
+  down by cause.
 * **targeted kill / graceful teardown** — ``kill(rank)`` stops one rank
   (optionally letting it respawn); ``shutdown()`` SIGTERMs everything,
   waits ``DMLC_LAUNCH_GRACEFUL_S``, SIGKILLs stragglers.
@@ -57,16 +64,25 @@ class LaunchTimeout(RuntimeError):
 
 class _Rank:
     """Supervision state for one rank (all mutation under the JobSet
-    lock; ``spawning`` guards the out-of-lock spawn window)."""
+    lock; ``spawning`` guards the out-of-lock spawn window).
 
-    __slots__ = ("rank", "handle", "last_handle", "attempt", "code", "done",
-                 "stopping", "retry_at", "spawning", "lost_cycles")
+    ``attempt`` counts every respawn (it drives ``DMLC_NUM_ATTEMPT`` and
+    backoff); ``crashes`` counts only the rank's OWN faults — the subset
+    that consumes ``DMLC_LAUNCH_RESTART_LIMIT``.  A host death (spot
+    preemption, node failure) respawns the rank without charging it:
+    the fault is the host's, tracked in the JobSet's per-host map."""
+
+    __slots__ = ("rank", "handle", "last_handle", "attempt", "crashes",
+                 "spawn_errors", "code", "done", "stopping", "retry_at",
+                 "spawning", "lost_cycles")
 
     def __init__(self, rank: int):
         self.rank = rank
         self.handle: Optional[WorkerHandle] = None
         self.last_handle: Optional[WorkerHandle] = None
         self.attempt = 0
+        self.crashes = 0
+        self.spawn_errors = 0
         self.code: Optional[int] = None
         self.done = False
         self.stopping = False
@@ -121,6 +137,11 @@ class JobSet:
         self._events: List[Dict[str, Any]] = []
         self._spawn_ms: List[float] = []
         self._respawns = 0
+        #: respawns scheduled, broken down by exit cause
+        #: ("crash" | "host_death" | "spawn_error")
+        self._respawns_by_cause: Dict[str, int] = {}
+        #: host-death charges per host — the budget a preemption burns
+        self._host_faults: Dict[str, int] = {}
         self._launched = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -154,9 +175,12 @@ class JobSet:
 
     # -- evidence --------------------------------------------------------
     def _event_locked(self, kind: str, rank: int, host: str = "",
-                      detail: str = "") -> None:
-        self._events.append({"ts": get_time(), "event": kind, "rank": rank,
-                             "host": host, "detail": detail})
+                      detail: str = "", cause: str = "") -> None:
+        ev = {"ts": get_time(), "event": kind, "rank": rank,
+              "host": host, "detail": detail}
+        if cause:
+            ev["cause"] = cause
+        self._events.append(ev)
         if _metrics.enabled():
             launch_metrics()["events"].inc(1, event=kind)
 
@@ -166,18 +190,23 @@ class JobSet:
             return [dict(e) for e in self._events]
 
     def stats(self) -> Dict[str, Any]:
-        """Supervision evidence: backend, respawns, spawn-latency p95,
-        and per-rank state — the ``bench.py --fleet`` launch record."""
+        """Supervision evidence: backend, respawns (total AND per exit
+        cause — crash vs host_death vs spawn_error), per-host fault
+        charges, spawn-latency p95, and per-rank state — the ``bench.py
+        --fleet`` launch record."""
         with self._lock:
             ms = sorted(self._spawn_ms)
             p95 = ms[min(len(ms) - 1, int(round(0.95 * (len(ms) - 1))))] if ms else 0.0
             return {
                 "backend": self._transport.name,
                 "respawns": self._respawns,
+                "respawns_by_cause": dict(self._respawns_by_cause),
+                "host_faults": dict(self._host_faults),
                 "spawn_ms_p95": p95,
                 "spawns": len(ms),
                 "ranks": {
-                    st.rank: {"attempt": st.attempt, "code": st.code,
+                    st.rank: {"attempt": st.attempt,
+                              "crashes": st.crashes, "code": st.code,
                               "done": st.done,
                               "host": st.handle.host if st.handle else None}
                     for st in self._ranks.values()},
@@ -248,15 +277,22 @@ class JobSet:
         except TransportError as e:
             with self._lock:
                 st.spawning = False
-                if st.stopping or attempt + 1 > self._restart_limit:
+                # spawn failures have their own budget counter: with
+                # host deaths no longer charging the rank, ``attempt``
+                # may legitimately exceed the restart limit
+                st.spawn_errors += 1
+                if st.stopping or st.spawn_errors > self._restart_limit:
                     st.done = True
                     if st.code is None:
                         st.code = 1
-                    self._event_locked("giveup", rank, "", str(e))
+                    self._event_locked("giveup", rank, "", str(e),
+                                       cause="spawn_error")
                 else:
                     st.attempt = attempt + 1
                     st.retry_at = (get_time()
                                    + self._retry.backoff_for(st.attempt))
+                    self._respawns_by_cause["spawn_error"] = \
+                        self._respawns_by_cause.get("spawn_error", 0) + 1
                     self._event_locked("spawn_error", rank, "", str(e))
             LOG("WARNING", "jobset %s: spawn of rank %d failed: %s",
                 self.name, rank, e)
@@ -342,6 +378,14 @@ class JobSet:
 
     def _on_exit(self, rank: int, handle: WorkerHandle, code: int) -> None:
         tail = ""
+        # attribute the exit BEFORE taking the lock: SSH classification
+        # may read the worker's log tail (file I/O)
+        cause = "crash"
+        if code != 0:
+            try:
+                cause = self._transport.classify_exit(handle, code)
+            except Exception:  # noqa: BLE001 — classification is advisory
+                cause = "crash"
         with self._lock:
             st = self._ranks.get(rank)
             if st is None or st.done or st.handle is not handle:
@@ -351,11 +395,16 @@ class JobSet:
                 st.done = True
                 self._event_locked("stop" if st.stopping else "exit",
                                    rank, handle.host, f"code={code}")
-            elif st.attempt + 1 > self._restart_limit:
+            elif cause == "crash" and st.crashes + 1 > self._restart_limit:
+                # only the rank's OWN faults spend its restart budget —
+                # a rank preempted N times by host deaths keeps the full
+                # budget for a genuine crash
                 st.done = True
                 self._event_locked("giveup", rank, handle.host,
                                    f"code={code} after "
-                                   f"{st.attempt + 1} attempts")
+                                   f"{st.crashes + 1} crashes "
+                                   f"({st.attempt + 1} attempts)",
+                                   cause=cause)
             else:
                 # detach the dead handle: a handle left in place would be
                 # re-polled (and re-counted against the budget) every
@@ -363,9 +412,18 @@ class JobSet:
                 st.handle = None
                 st.last_handle = handle
                 st.attempt += 1
+                if cause == "crash":
+                    st.crashes += 1
+                else:
+                    # the host ate the fault, not the rank
+                    self._host_faults[handle.host] = \
+                        self._host_faults.get(handle.host, 0) + 1
+                self._respawns_by_cause[cause] = \
+                    self._respawns_by_cause.get(cause, 0) + 1
                 st.retry_at = get_time() + self._retry.backoff_for(st.attempt)
                 self._event_locked("exit", rank, handle.host,
-                                   f"code={code} respawn={st.attempt}")
+                                   f"code={code} respawn={st.attempt}",
+                                   cause=cause)
             gave_up = st.done and code != 0 and not st.stopping
         if gave_up:
             tail = self._transport.log_tail(handle, 2048)

@@ -29,6 +29,7 @@ renders indexed-Job manifests rather than holding a process handle).
 
 from __future__ import annotations
 
+import math
 import os
 import shlex
 import signal
@@ -125,6 +126,16 @@ class Transport:
         del host
         return True
 
+    def classify_exit(self, handle: WorkerHandle, code: int) -> str:
+        """Attribute a worker's unexpected exit: ``"host_death"`` when
+        the host itself is gone (spot preemption, node failure — the
+        JobSet charges the HOST's fault budget, not the rank's restart
+        budget), else ``"crash"`` (the worker's own fault).  The base
+        rule is simply the host-liveness view; transports with richer
+        evidence (SSH connect errors) refine it."""
+        del code
+        return "host_death" if not self.host_alive(handle.host) else "crash"
+
     def spawn(self, command: List[str], env: Dict[str, str],
               host: str, label: str = "worker") -> WorkerHandle:
         raise NotImplementedError
@@ -214,6 +225,20 @@ class LocalTransport(Transport):
                 pass            # lost the race with the exit
 
 
+#: stderr signatures of an ssh CONNECT failure (vs the remote command
+#: failing): the host itself is unreachable, so the exit is a host
+#: death, not a worker crash
+_SSH_CONNECT_ERRORS = (
+    "connection refused",
+    "connection timed out",
+    "no route to host",
+    "could not resolve hostname",
+    "ssh: connect to host",
+    "connection reset by peer",
+    "network is unreachable",
+)
+
+
 class SSHTransport(LocalTransport):
     """One worker per ``ssh`` child; the remote command carries the env.
 
@@ -223,6 +248,16 @@ class SSHTransport(LocalTransport):
     teardown, pdeathsig) hangs up the remote side too instead of
     orphaning it — the supervised replacement for the fire-and-forget
     ``tracker/ssh.py`` launch.
+
+    **Dead-host detection**: ssh exits 255 for its OWN failures.  A 255
+    whose log tail carries a connect-error signature (connection
+    refused/timed out, no route, unresolvable name) — or no output at
+    all, the connect died before the remote shell spoke — is classified
+    as a *host death*: the host is marked down (``host_alive`` False,
+    excluded from placement) until :meth:`restore_host`, and the JobSet
+    respawns the rank on a survivor without charging its restart
+    budget.  A 255 with remote output is the remote command's own exit
+    status — a crash like any other.
     """
 
     name = "ssh"
@@ -234,6 +269,38 @@ class SSHTransport(LocalTransport):
         CHECK(len(hosts) > 0, "SSHTransport: empty host list")
         self.cwd = cwd or os.getcwd()
         self.ssh_binary = ssh_binary
+        self._dead_lock = threading.Lock()
+        self._dead: set = set()
+
+    def host_alive(self, host: str) -> bool:
+        with self._dead_lock:
+            return host not in self._dead
+
+    def restore_host(self, host: str) -> None:
+        """Forget a host death (capacity came back / ops fixed it) so
+        placement may use the host again."""
+        with self._dead_lock:
+            self._dead.discard(host)
+
+    def down_hosts(self) -> List[str]:
+        with self._dead_lock:
+            return sorted(self._dead)
+
+    def classify_exit(self, handle: WorkerHandle, code: int) -> str:
+        if not self.host_alive(handle.host):
+            return "host_death"
+        if code != 255:
+            return "crash"
+        tail = self.log_tail(handle, 4096).lower()
+        if tail.strip() and not any(sig in tail
+                                    for sig in _SSH_CONNECT_ERRORS):
+            return "crash"      # remote command's own exit 255
+        with self._dead_lock:
+            self._dead.add(handle.host)
+        LOG("WARNING", "ssh transport: host %s classified dead "
+            "(exit 255, connect error) — excluded from placement",
+            handle.host)
+        return "host_death"
 
     def build_argv(self, host: str, command: List[str],
                    env: Dict[str, str]) -> List[str]:
@@ -268,7 +335,12 @@ class FakeTransport(LocalTransport):
       cluster has live workers*.  ``kill=<host>`` SIGKILLs every worker
       on that host and marks it down (``host_alive`` False, spawns on it
       raise) — the scripted mid-round host death of
-      ``scripts/check_launch.py``.
+      ``scripts/check_launch.py``.  ``wave=<fraction>`` is the scripted
+      **spot-preemption wave**: downs ``ceil(fraction * hosts)`` of the
+      currently-alive hosts AT ONCE, in host-list order (default 0.3 —
+      a 30% capacity loss in one tick, the prodsim drill's scenario);
+      ``restore`` brings every downed host back (spot capacity
+      returning).
 
     ``fail_host`` / ``restore_host`` give tests direct control without
     the grammar.
@@ -314,11 +386,34 @@ class FakeTransport(LocalTransport):
         if not busy:
             return
         fault = faultinject.check("launch_host")
-        if fault is not None and fault.kind in ("kill", "down"):
+        if fault is None:
+            return
+        if fault.kind in ("kill", "down"):
             host = fault.value or self._hosts[0]
             LOG("WARNING", "fake transport: injected %s of host %s",
                 fault.kind, host)
             self.fail_host(host)
+        elif fault.kind == "wave":
+            self.preempt_wave(float(fault.value or "0.3"))
+        elif fault.kind == "restore":
+            for host in self.down_hosts():
+                LOG("INFO", "fake transport: injected restore of host %s",
+                    host)
+                self.restore_host(host)
+
+    def preempt_wave(self, fraction: float = 0.3) -> List[str]:
+        """Spot-preemption wave: down ``ceil(fraction * hosts)`` of the
+        currently-alive hosts at once (host-list order, so the victim
+        set is deterministic); returns the victims."""
+        uniq = list(dict.fromkeys(self._hosts))    # dedupe, keep order
+        alive = [h for h in uniq if self.host_alive(h)]
+        n = min(len(alive), max(1, math.ceil(fraction * len(alive))))
+        victims = alive[:n]
+        LOG("WARNING", "fake transport: spot-preemption wave downs "
+            "%d/%d hosts at once: %s", n, len(alive), victims)
+        for host in victims:
+            self.fail_host(host)
+        return victims
 
     def fail_host(self, host: str) -> None:
         """Down a fake host: SIGKILL its live workers, refuse spawns."""

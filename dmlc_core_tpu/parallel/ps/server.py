@@ -352,6 +352,12 @@ class PSServer:
             with self._cond:
                 self._byes += 1
                 byes = self._byes
+                # a departed worker must not hold the SSP floor: its
+                # clock is frozen forever, so leaving it in the vector
+                # clock deadlocks every surviving reader that is more
+                # than tau ahead of it
+                self._vclock.pop(int(msg.get("rank", -1)), None)
+                self._cond.notify_all()
             if byes >= self.nworker:
                 self._done.set()
             return {"ok": 1}, []

@@ -248,6 +248,31 @@ env JAX_PLATFORMS=cpu \
     TENANCY_SLO_OUT="${TENANCY_SLO_OUT:-/tmp/tenancy_slo.json}" \
     python scripts/check_tenancy.py
 
+echo "== production-day simulation (whole-stack chaos, one SLO scorecard) =="
+# one composed run: live event stream -> OnlineTrainer with tenant-scoped
+# rollout refreshes, sparse-CTR fit_ps on a real PS fleet, and a
+# multi-tenant replica fleet on a fake 6-host cluster serving diurnal
+# Zipf load — while the deterministic chaos schedule (at=/every=
+# wall-clock triggers, DMLC_FAULT_SEED) faults EVERY tier mid-run:
+# replica SIGKILL, PS server SIGKILL (respawn + snapshot restore), a
+# spot-preemption wave downing 30% of hosts at once, corrupt stream
+# shard bytes (tailer resync), and a poisoned tenant publish (eval gate
+# rollback, tenant-scoped).  GREEN gates on >= 99% availability with
+# zero dropped / zero wrong, cause-fair respawn budgets, zero
+# lock/race/leak findings, and the ONE committed SLO scorecard
+# scripts/slo/prodsim.json (doc/robustness.md "Production-day
+# simulation").  CI runs the smoke window; the archived PRODSIM_r0*.json
+# evidence chain uses the full DMLC_PRODSIM_SECONDS default.
+env JAX_PLATFORMS=cpu \
+    DMLC_PRODSIM_SECONDS="${DMLC_PRODSIM_SECONDS:-12}" \
+    PRODSIM_OUT="${PRODSIM_OUT:-/tmp/prodsim_drill.json}" \
+    PRODSIM_RACECHECK_OUT="${PRODSIM_RACECHECK_OUT:-/tmp/prodsim_racecheck.json}" \
+    PRODSIM_LEAKCHECK_OUT="${PRODSIM_LEAKCHECK_OUT:-/tmp/prodsim_leakcheck.json}" \
+    PRODSIM_METRICS_OUT="${PRODSIM_METRICS_OUT:-/tmp/prodsim_metrics.json}" \
+    PRODSIM_TRACE_OUT="${PRODSIM_TRACE_OUT:-/tmp/prodsim_trace.json}" \
+    PRODSIM_SLO_OUT="${PRODSIM_SLO_OUT:-/tmp/prodsim_slo.json}" \
+    python scripts/check_prodsim.py
+
 if [[ "${1:-}" != "quick" ]]; then
     echo "== native build =="
     make -C cpp -j"$(nproc)"

@@ -22,6 +22,7 @@ from dmlc_core_tpu.launch import (FakeTransport, JobSet, K8sTransport,
                                   LaunchTimeout, LocalTransport,
                                   SSHTransport, TransportError,
                                   jobset_from_opts, transport_from_opts)
+from dmlc_core_tpu.launch.transport import WorkerHandle
 from dmlc_core_tpu.tracker.opts import get_opts
 
 PY = sys.executable
@@ -132,6 +133,47 @@ class TestSSHTransport:
         assert val == "7"
         assert os.path.realpath(cwd) == os.path.realpath(str(tmp_path))
 
+    def _handle(self, tmp_path, host, text):
+        log = tmp_path / f"{host}.log"
+        log.write_text(text)
+        return WorkerHandle(host, "w", {}, log_path=str(log))
+
+    def test_classify_connect_error_255_is_host_death(self, tmp_path):
+        tr = SSHTransport(["h0", "h1"])
+        h = self._handle(tmp_path, "h0",
+                         "ssh: connect to host h0 port 22: "
+                         "Connection refused\r\n")
+        assert tr.classify_exit(h, 255) == "host_death"
+        assert not tr.host_alive("h0") and tr.down_hosts() == ["h0"]
+        # once marked dead, ANY exit on that host classifies host_death
+        h2 = self._handle(tmp_path, "h0", "Traceback: boom\n")
+        assert tr.classify_exit(h2, 1) == "host_death"
+        tr.restore_host("h0")
+        assert tr.host_alive("h0") and tr.down_hosts() == []
+
+    def test_classify_silent_255_is_host_death(self, tmp_path):
+        # connect died before the remote shell spoke: no output at all
+        tr = SSHTransport(["h0"])
+        h = self._handle(tmp_path, "h0", "")
+        assert tr.classify_exit(h, 255) == "host_death"
+        assert tr.down_hosts() == ["h0"]
+
+    def test_classify_remote_255_with_output_is_crash(self, tmp_path):
+        # the remote COMMAND exited 255 (it printed real output): the
+        # host is fine and must stay in the placement pool
+        tr = SSHTransport(["h0"])
+        h = self._handle(tmp_path, "h0", "remote job: exploding now\n")
+        assert tr.classify_exit(h, 255) == "crash"
+        assert tr.host_alive("h0") and tr.down_hosts() == []
+
+    def test_classify_ordinary_exit_is_crash(self, tmp_path):
+        tr = SSHTransport(["h0"])
+        h = self._handle(tmp_path, "h0",
+                         "ssh: connect to host h0: Connection refused\n")
+        # non-255 exits never consult the log: ssh itself succeeded
+        assert tr.classify_exit(h, 1) == "crash"
+        assert tr.host_alive("h0")
+
 
 class TestFakeTransport:
     def test_fail_host_kills_and_refuses(self, tmp_path):
@@ -160,6 +202,32 @@ class TestFakeTransport:
             tr.tick()
         assert _wait_code(tr, h) == -signal.SIGKILL
         assert tr.down_hosts() == ["h1"]
+
+    def test_preempt_wave_downs_fraction_at_once(self, tmp_path):
+        tr = FakeTransport(hosts=["h0", "h1", "h2", "h3", "h4", "h5"],
+                           log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c", "import time; time.sleep(30)"], {}, "h0")
+        downed = tr.preempt_wave(0.3)
+        assert downed == ["h0", "h1"]        # ceil(0.3 * 6) = 2, in order
+        assert tr.down_hosts() == ["h0", "h1"]
+        assert _wait_code(tr, h) == -signal.SIGKILL
+        with pytest.raises(TransportError, match="down"):
+            tr.spawn([PY, "-c", "pass"], {}, "h1")
+        # a second wave preempts from the SURVIVORS only
+        assert tr.preempt_wave(0.3) == ["h2", "h3"]
+        for host in ("h0", "h1", "h2", "h3"):
+            tr.restore_host(host)
+        assert tr.down_hosts() == []
+
+    def test_injected_wave_on_tick(self, tmp_path):
+        tr = FakeTransport(hosts=["h0", "h1", "h2", "h3"],
+                           log_dir=str(tmp_path))
+        h = tr.spawn([PY, "-c", "import time; time.sleep(30)"], {}, "h0")
+        with faultinject.inject("launch_host:wave=0.5:n=1"):
+            tr.tick()
+        # wave downs ceil(0.5*4)=2 alive hosts in host-list order
+        assert tr.down_hosts() == ["h0", "h1"]
+        assert _wait_code(tr, h) == -signal.SIGKILL
 
 
 class TestK8sTransport:
@@ -314,6 +382,46 @@ class TestJobSet:
         assert codes == [0, 0]
         kinds = [e["event"] for e in js.events()]
         assert "spawn_error" in kinds and "respawn" in kinds
+
+    def test_host_death_spares_rank_crash_budget(self, tmp_path):
+        """Cause-fair budgets: a host death charges the HOST, not the
+        rank — a rank chased off two dying hosts still has its full
+        crash budget left (the prodsim spot-preemption contract)."""
+        tr = FakeTransport(hosts=["h0", "h1", "h2"], log_dir=str(tmp_path))
+        js = JobSet([PY, "-c", "import time; time.sleep(30)"], 1,
+                    transport=tr, restart_limit=1, monitor_s=0.05)
+        js.launch()
+        try:
+            for _ in range(2):              # two successive host deaths
+                host = js.rank_host(0)
+                n = js.respawns()
+                tr.fail_host(host)
+                deadline = time.time() + 15
+                while js.respawns() == n and time.time() < deadline:
+                    time.sleep(0.05)
+                assert js.respawns() == n + 1
+            st = js.stats()
+            assert st["respawns_by_cause"]["host_death"] == 2
+            assert sum(st["host_faults"].values()) == 2
+            assert st["ranks"][0]["crashes"] == 0   # budget untouched
+            # full crash budget intact: a real SIGKILL still respawns
+            # (restart_limit=1) instead of giving up
+            n = js.respawns()
+            js.kill(0, sig=signal.SIGKILL, respawn=True)
+            deadline = time.time() + 15
+            while js.respawns() == n and time.time() < deadline:
+                time.sleep(0.05)
+            st = js.stats()
+            assert st["respawns_by_cause"]["crash"] == 1
+            assert st["ranks"][0]["crashes"] == 1
+            events = js.events()
+            assert "giveup" not in [e["event"] for e in events]
+            causes = [e.get("cause") for e in events
+                      if e["event"] == "exit"]
+            assert causes.count("host_death") == 2
+            assert causes.count("crash") == 1
+        finally:
+            js.shutdown()
 
 
 # ---------------------------------------------------------------------------

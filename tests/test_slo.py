@@ -90,6 +90,38 @@ class TestResolution:
                             "stat": stat}}), snap)
             assert card["objectives"][0]["pass"], stat
 
+    def test_any_pnn_quantile_selector(self):
+        # p<nn> resolves ANY two-digit quantile over the pooled
+        # reservoir, not just the p50/p95/p99 the summaries print
+        r = M.MetricsRegistry(namespace="dmlc")
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        for v in range(1, 101):
+            h.observe(v / 100.0)            # 0.01 .. 1.00 uniformly
+        snap = r.snapshot()
+
+        def q(stat):
+            card = slo.evaluate(_spec(
+                {"name": stat, "op": ">=", "threshold": 0,
+                 "source": {"metric": "dmlc_lat_seconds",
+                            "stat": stat}}), snap)
+            return card["objectives"][0]["observed"]
+
+        assert q("p10") == pytest.approx(0.10, abs=0.02)
+        assert q("p25") == pytest.approx(0.25, abs=0.02)
+        assert q("p75") == pytest.approx(0.75, abs=0.02)
+        assert q("p90") == pytest.approx(0.90, abs=0.02)
+        assert q("p10") < q("p25") < q("p75") < q("p90")
+
+    def test_bogus_quantile_stat_fails_not_passes(self):
+        # "p999" matches no selector: the value is unresolvable, and an
+        # unresolvable objective FAILS (never silently passes)
+        card = slo.evaluate(_spec(
+            {"name": "x", "op": "<=", "threshold": 1e9,
+             "source": {"metric": "dmlc_wait_seconds",
+                        "stat": "p999"}}), _snapshot())
+        obj = card["objectives"][0]
+        assert not obj["pass"] and obj["observed"] is None
+
     def test_evidence_dotted_path(self):
         card = slo.evaluate(
             _spec({"name": "dropped", "op": "==", "threshold": 0,
@@ -158,7 +190,8 @@ class TestFailureSemantics:
 class TestCommittedSpecs:
     """The specs the drills gate on must always validate."""
 
-    @pytest.mark.parametrize("name", ["fleet.json", "ps.json"])
+    @pytest.mark.parametrize("name", ["fleet.json", "ps.json",
+                                      "tenancy.json", "prodsim.json"])
     def test_committed_spec_validates(self, name):
         import os
         path = os.path.join(os.path.dirname(os.path.dirname(
