@@ -201,6 +201,13 @@ def _attach_metrics(out):
             sw.flush()
             _, nprocs = metrics_agg.merge_spool(os.path.dirname(sw.path))
             summary["spool_processes_merged"] = nprocs
+        # under DMLC_JITCHECK=1 the record carries the steady-state
+        # compile count across every steady window this process opened
+        # (0 = the PR 18 warmup fix holds under the dynamic gate)
+        from dmlc_core_tpu.base import jitcheck
+        if jitcheck.installed():
+            summary["recompiles_steady_state"] = len(
+                jitcheck.compiles("steady"))
         out["metrics_summary"] = summary
     except Exception as e:  # noqa: BLE001
         out["metrics_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -1180,6 +1187,7 @@ def _stream_bench() -> None:
 
     import jax  # noqa: F401 — device init before timing anything
 
+    from dmlc_core_tpu.base import jitcheck
     from dmlc_core_tpu.base.metrics import default_registry
     from dmlc_core_tpu.io.recordio import encode_records
     from dmlc_core_tpu.models import HistGBT
@@ -1259,6 +1267,7 @@ def _stream_bench() -> None:
     staleness = []
     served_floor = 0                  # events covered by an activation
     refreshes = []
+    steady_marked = False
     end = time.perf_counter() + duration
     try:
         while time.perf_counter() < end:
@@ -1267,6 +1276,13 @@ def _stream_bench() -> None:
             if r is None:
                 continue
             refreshes.append(r)
+            if (not steady_marked and jitcheck.installed()
+                    and r["window_rows"] >= chunk_rows * window_chunks):
+                # the sliding window just reached its final shape, so
+                # every refresh program is compiled — from here on a
+                # refresh that compiles is a steady-state stall
+                jitcheck.steady()
+                steady_marked = True
             if r.get("activated"):
                 now = time.time()
                 covered = min(r["records_total"], len(append_ts))
@@ -1312,10 +1328,16 @@ def _stream_bench() -> None:
         "events_served": served_floor,
         "trees_total": len(model.trees),
         "registry_versions": len(registry.versions()),
+        "recompiles_steady_state": (len(jitcheck.compiles("steady"))
+                                    if steady_marked else None),
         **cfg,
     }
     _stream_emit(final, final=True)
     shutil.rmtree(root, ignore_errors=True)
+    if steady_marked:
+        # DMLC_JITCHECK=1 turns the record into a gate: any compile
+        # after the window filled fails the bench outright
+        jitcheck.check()
 
 
 def _ps_bench() -> None:
@@ -1584,6 +1606,7 @@ def _prodsim_bench() -> dict:
     import tempfile
 
     from dmlc_core_tpu.base import faultinject
+    from dmlc_core_tpu.base import jitcheck
     from dmlc_core_tpu.base import knobs as _knobs
 
     duration = min(float(_knobs.value("DMLC_PRODSIM_SECONDS")),
@@ -1763,6 +1786,13 @@ def _prodsim_bench() -> dict:
             if r is None:
                 continue
             refreshes.append(r)
+            if (jitcheck.installed()
+                    and jitcheck.current_phase() == "warmup"
+                    and r.get("window_rows", 0) >= 512 * 2):
+                # trainer window (chunk_rows=512 × window_chunks=2) just
+                # reached its final shape — the parent's only jax work
+                # from here is refresh reuse, so compiles are stalls
+                jitcheck.steady()
             with live_lock:
                 version = live_state["version"] + 1
                 live_state["version"] = version
@@ -2013,6 +2043,13 @@ def _prodsim_bench() -> dict:
         stop_stream.set()
         lane_t.join(timeout=120)
 
+        # the production day is over — close the steady window before
+        # the oracle probes below (their fresh batch shapes may compile;
+        # that is post-run bookkeeping, not a serving-path stall)
+        recompiles_steady = (len(jitcheck.compiles("steady"))
+                             if jitcheck.installed() else None)
+        jitcheck.warmup()
+
         # live-tenant oracle: the routed answer must be bit-identical to
         # the snapshot of the last ACTIVATED refresh (reconciler still
         # healing respawned replicas, so allow convergence time)
@@ -2174,9 +2211,14 @@ def _prodsim_bench() -> dict:
                 "static_rollbacks": static_rb,
                 "isolated": isolated,
             },
+            "recompiles_steady_state": recompiles_steady,
             **cfg,
         }
         _prodsim_emit(rec, final=True)
+        if recompiles_steady is not None:
+            # DMLC_JITCHECK=1 makes the record a gate: a compile during
+            # the load window is a steady-state stall, fail loudly
+            jitcheck.check()
         return rec
     finally:
         stop_gen.set()
@@ -2231,6 +2273,7 @@ def main() -> None:
     import jax
 
     from dmlc_core_tpu.base import compile_cache as _cc
+    from dmlc_core_tpu.base import jitcheck
     from dmlc_core_tpu.models import HistGBT
     from dmlc_core_tpu.parallel.mesh import local_mesh
 
@@ -2345,19 +2388,34 @@ def main() -> None:
         round-2 BENCH capture was 68× off) shows up as a worst/best
         chunk ratio ≫ 1."""
         EV["chunk_times"] = []
+        steady_before = (len(jitcheck.compiles("steady"))
+                         if jitcheck.installed() else 0)
 
         def cb(done, t_s):
             EV["chunk_times"].append((done, t_s))
+            if (jitcheck.installed()
+                    and jitcheck.current_phase() == "warmup"):
+                # first chunk on host ⇒ warmup (compile-join + warm
+                # dispatch) is over; any compile in chunks 2..N is the
+                # PR 18 bug class resurfacing mid-fit
+                jitcheck.steady()
             emit()
 
         model.fit_device(dd, warmup_rounds=warmup_rounds,
                          chunk_callback=cb)
+        recompiles_steady = None
+        if jitcheck.installed():
+            recompiles_steady = (len(jitcheck.compiles("steady"))
+                                 - steady_before)
+            jitcheck.warmup()   # smokes/re-measures compile legitimately
         seconds = model.last_fit_seconds
         out = {
             "seconds": round(seconds, 3),
             "warmup_seconds": round(model.last_warmup_seconds, 3),
             "rounds_done": rounds,
         }
+        if recompiles_steady is not None:
+            out["recompiles_steady_state"] = recompiles_steady
         # cold-start breakdown (doc/performance.md): warmup_seconds =
         # compile-join residue + warm dispatch; compile_seconds is the
         # background compile's critical path (null on the inline path);

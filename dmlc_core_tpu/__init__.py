@@ -94,6 +94,15 @@ if _leakcheck.env_enabled():
     # Installed AFTER racecheck so the Thread.start hooks chain.
     _leakcheck.install()
 
+from dmlc_core_tpu.base import jitcheck as _jitcheck
+
+if _jitcheck.env_enabled():
+    # DMLC_JITCHECK=1: every XLA compilation after this point is traced
+    # with its repo-frame stack and phase tag (warmup until
+    # base.jitcheck.steady() is called); steady-state compiles fail
+    # base.jitcheck.check() (see doc/static_analysis.md).
+    _jitcheck.install()
+
 from dmlc_core_tpu.base.logging import (  # noqa: F401
     Error,
     LOG,

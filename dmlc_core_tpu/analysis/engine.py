@@ -26,11 +26,12 @@ import ast
 import io
 import json
 import os
+import pickle
 import re
 import time
 import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "ALL_RULES", "AnalysisContext", "Finding", "ParsedFile", "analyze",
@@ -43,6 +44,7 @@ ALL_RULES: Tuple[str, ...] = (
     "lock-discipline", "lock-release",
     "lock-blocking", "atomicity",
     "jit-purity",
+    "recompile-hazard", "donation-discipline", "transfer-discipline",
     "knob-registry", "knob-doc",
     "metric-registry", "metric-doc",
     "resource-leak", "thread-lifecycle",
@@ -172,6 +174,10 @@ class AnalysisContext:
     #: pass-module name -> wall seconds spent, filled by ``analyze`` so
     #: the CLI can attribute the 10s CI budget
     pass_seconds: Dict[str, float] = field(default_factory=dict)
+    #: incremental-cache accounting filled by ``analyze`` when a cache
+    #: path was given: files/hits counts plus whether the whole finding
+    #: set was reused (``--timings`` reports the hit rate)
+    cache_stats: Dict[str, Any] = field(default_factory=dict)
 
     def add(self, pf: ParsedFile, line: int, rule: str, message: str,
             key: str) -> None:
@@ -247,16 +253,88 @@ def _load_docs(root: str) -> Dict[str, str]:
     return out
 
 
+#: bump on any ParsedFile / Finding layout change — stale pickled
+#: cache entries from an older engine must never deserialize
+_CACHE_VERSION = 1
+
+
+def _stat_key(path: str) -> List[int]:
+    st = os.stat(path)
+    return [st.st_mtime_ns, st.st_size]
+
+
+def _extra_state(root: str) -> Dict[str, List[int]]:
+    """(mtime, size) of every input that feeds passes OUTSIDE the
+    walked file set — the analysis sources themselves and the doc
+    pages (knob/metric/wire docs gate findings on files that did not
+    change).  Any drift here invalidates the whole-run finding reuse
+    (per-file finding caching is unsound anyway: metric-registry and
+    wire-schema findings cross files)."""
+    out: Dict[str, List[int]] = {}
+    adir = os.path.dirname(os.path.abspath(__file__))
+    for f in sorted(os.listdir(adir)):
+        if f.endswith(".py"):
+            out["analysis:" + f] = _stat_key(os.path.join(adir, f))
+    doc_dir = os.path.join(root, "doc")
+    if os.path.isdir(doc_dir):
+        for dirpath, dirnames, filenames in os.walk(doc_dir):
+            dirnames.sort()
+            for f in sorted(filenames):
+                if f.endswith(".md"):
+                    p = os.path.join(dirpath, f)
+                    out[os.path.relpath(p, root).replace(os.sep, "/")] \
+                        = _stat_key(p)
+    return out
+
+
+def _load_cache(path: Optional[str]) -> Optional[Dict[str, Any]]:
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        if data.get("version") != _CACHE_VERSION:
+            return None
+        return data
+    except Exception:  # noqa: BLE001 — any corrupt cache = cold run
+        return None
+
+
+def _write_cache(path: str, data: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except OSError:
+        # a read-only checkout must not fail the analysis
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def analyze(root: str,
             files: Optional[Sequence[Tuple[str, str]]] = None,
-            rules: Optional[Sequence[str]] = None) -> AnalysisContext:
+            rules: Optional[Sequence[str]] = None,
+            cache_path: Optional[str] = None) -> AnalysisContext:
     """Parse once, run the selected passes, return the context (findings
     NOT yet baseline-filtered — the CLI owns that policy).  Per-pass
-    wall time lands in ``ctx.pass_seconds``."""
+    wall time lands in ``ctx.pass_seconds``.
+
+    ``cache_path`` enables the incremental cache: per-file pickled
+    parses keyed on (mtime_ns, size) make re-parses cheap, and when
+    EVERY input is unchanged (files, docs, analysis sources, rule
+    selection) the previous run's findings are reused outright and no
+    pass executes.  Finding reuse is all-or-nothing by design — the
+    registry/protocol passes emit cross-file findings, so a per-file
+    finding cache would silently miss e.g. a duplicate metric declared
+    in an unchanged file."""
     # late imports: engine <-> passes would otherwise cycle
-    from dmlc_core_tpu.analysis import (atomicity, blocking, jitpure,
-                                        locks, protocol, registries,
-                                        resources, style)
+    from dmlc_core_tpu.analysis import (atomicity, blocking, jaxpass,
+                                        jitpure, locks, protocol,
+                                        registries, resources, style)
 
     if files is None:
         files = default_files(root)
@@ -265,14 +343,53 @@ def analyze(root: str,
     if bad:
         raise ValueError(f"unknown dmlcheck rule(s): {sorted(bad)}")
     t0 = time.perf_counter()
-    parsed = [
-        ParsedFile(p, os.path.relpath(p, root).replace(os.sep, "/"), kind)
-        for p, kind in files
-    ]
+    cache = _load_cache(cache_path)
+    cached_files: Dict[str, Dict[str, Any]] = \
+        (cache or {}).get("files", {})
+    parsed: List[ParsedFile] = []
+    new_entries: Dict[str, Dict[str, Any]] = {}
+    hits = 0
+    for p, kind in files:
+        rel = os.path.relpath(p, root).replace(os.sep, "/")
+        key = _stat_key(p) + [kind]
+        ent = cached_files.get(rel)
+        pf: Optional[ParsedFile] = None
+        if ent is not None and ent["key"] == key:
+            try:
+                pf = pickle.loads(ent["blob"])
+                hits += 1
+            except Exception:  # noqa: BLE001 — corrupt entry = reparse
+                pf = None
+        if pf is None:
+            pf = ParsedFile(p, rel, kind)
+            ent = {"key": key,
+                   "blob": pickle.dumps(
+                       pf, protocol=pickle.HIGHEST_PROTOCOL)}
+        new_entries[rel] = ent
+        parsed.append(pf)
     ctx = AnalysisContext(root=root, files=parsed)
+    ctx.pass_seconds["parse"] = time.perf_counter() - t0
+    extra = _extra_state(root) if cache_path else {}
+    if cache_path:
+        ctx.cache_stats = {"files": len(parsed), "hits": hits,
+                           "findings_reused": False}
+
+    rules_key = sorted(selected)
+    if (cache is not None
+            and hits == len(parsed)
+            and set(cached_files) == set(new_entries)
+            and cache.get("extra") == extra
+            and cache.get("rules") == rules_key
+            and cache.get("findings") is not None):
+        # full hit: every input byte-stable since the cached run —
+        # reuse its findings, run nothing
+        ctx.findings = [Finding(*t) for t in cache["findings"]]
+        ctx.suppressed_count = cache.get("suppressed", 0)
+        ctx.cache_stats["findings_reused"] = True
+        return ctx
+
     ctx.knobs = _load_knob_registry(root, ctx.knobs_rel)
     ctx.docs = _load_docs(root)
-    ctx.pass_seconds["parse"] = time.perf_counter() - t0
 
     def _timed(name: str, fn, *args) -> None:
         t = time.perf_counter()
@@ -289,6 +406,9 @@ def analyze(root: str,
         _timed("atomicity", atomicity.run, ctx, selected)
     if "jit-purity" in selected:
         _timed("jitpure", jitpure.run, ctx)
+    if selected & {"recompile-hazard", "donation-discipline",
+                   "transfer-discipline"}:
+        _timed("jaxpass", jaxpass.run, ctx, selected)
     if selected & {"knob-registry", "knob-doc", "metric-registry",
                    "metric-doc"}:
         _timed("registries", registries.run, ctx, selected)
@@ -297,6 +417,16 @@ def analyze(root: str,
     if selected & {"collective-discipline", "wire-schema"}:
         _timed("protocol", protocol.run, ctx, selected)
     ctx.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    if cache_path:
+        _write_cache(cache_path, {
+            "version": _CACHE_VERSION,
+            "files": new_entries,
+            "extra": extra,
+            "rules": rules_key,
+            "findings": [(f.path, f.line, f.rule, f.message, f.key)
+                         for f in ctx.findings],
+            "suppressed": ctx.suppressed_count,
+        })
     return ctx
 
 
@@ -304,9 +434,9 @@ def rule_help(rule: str) -> Dict[str, str]:
     """``--explain`` payload for ``rule``: the pass's one-paragraph doc
     plus a minimal flagged/clean source pair.  Falls back to the pass
     module's docstring for rules without a curated example."""
-    from dmlc_core_tpu.analysis import (atomicity, blocking, jitpure,
-                                        locks, protocol, registries,
-                                        resources, style)
+    from dmlc_core_tpu.analysis import (atomicity, blocking, jaxpass,
+                                        jitpure, locks, protocol,
+                                        registries, resources, style)
 
     if rule not in ALL_RULES:
         raise ValueError(f"unknown dmlcheck rule: {rule}")
@@ -315,6 +445,8 @@ def rule_help(rule: str) -> Dict[str, str]:
         "lock-discipline": locks, "lock-release": locks,
         "lock-blocking": blocking, "atomicity": atomicity,
         "jit-purity": jitpure,
+        "recompile-hazard": jaxpass, "donation-discipline": jaxpass,
+        "transfer-discipline": jaxpass,
         "knob-registry": registries, "knob-doc": registries,
         "metric-registry": registries, "metric-doc": registries,
         "resource-leak": resources, "thread-lifecycle": resources,

@@ -1,0 +1,238 @@
+"""XLA-compile tracer (``DMLC_JITCHECK=1``): zero steady-state recompiles.
+
+Dynamic companion to dmlcheck's ``recompile-hazard`` pass.  The static
+rule proves cache *keys* are stable shapes; this module proves the
+dynamic half: after a drill or bench declares its warmup over, **zero**
+further XLA compilations happen in the process.  A steady-state compile
+is the bug class PR 18 fixed by postmortem — a 98 s recompile hiding
+behind a warm persistent cache — and the one PR 6's "zero recompiles on
+refresh" promise depends on.  Nothing enforced it until now.
+
+Mechanics: :func:`install` wraps ``jax._src.compiler
+.compile_or_get_cached`` — the one choke point every in-process
+compilation funnels through (``pxla`` calls it via the module
+attribute, so assignment is enough).  It is deliberately BELOW the
+persistent compile cache's entry: a compilation-cache *hit* still
+passes through here, because a hit still costs a trace + lowering +
+deserialize stall at steady state (exactly how the PR 18 bug hid).
+Each call records the lowered module name, wall seconds, the current
+phase tag (``warmup`` until :func:`steady` is called) and up to three
+repo-relative stack frames, and bumps ``dmlc_recompiles_total{phase}``.
+
+The CI drills install this next to lockcheck/racecheck/leakcheck,
+archive :func:`write_report` JSON (``*_JITCHECK_OUT``) and gate GREEN
+on :func:`check` — which raises on any ``steady``-phase record.  When
+the env gate is off nothing is patched and dispatch runs untouched.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["JitCompileError", "install", "uninstall", "installed",
+           "compiles", "current_phase", "steady", "warmup", "reset",
+           "check", "write_report", "env_enabled"]
+
+
+class JitCompileError(RuntimeError):
+    """At least one XLA compilation happened after steady() at check()."""
+
+
+#: guards the record table; a RAW interpreter lock, immune to
+#: lockcheck's factory patching regardless of import order
+_state_lock = _thread.allocate_lock()
+
+_enabled = False
+_phase = "warmup"
+_records: List[Dict[str, Any]] = []
+
+#: original captured at install() time (NOT import time) so repeated
+#: install/uninstall cycles restore the true jax entry point
+_saved: Dict[str, Any] = {}
+
+
+def _repo_site(depth: int) -> Optional[str]:
+    """Up to three repo-relative ``file:line(func)`` frames above the
+    hook (compiles are synchronous on the dispatch path, so the
+    triggering repo call site is on the stack)."""
+    frames: List[str] = []
+    try:
+        f: Any = sys._getframe(depth)
+    except ValueError:
+        return None
+    hops = 0
+    while f is not None and len(frames) < 3 and hops < 80:
+        fn = f.f_code.co_filename
+        if fn == __file__:                  # our own hook is not a site
+            f = f.f_back
+            hops += 1
+            continue
+        for marker in ("dmlc_core_tpu", "tests", "scripts"):
+            i = fn.find(os.sep + marker + os.sep)
+            if i >= 0:
+                frames.append(f"{fn[i + 1:]}:{f.f_lineno}"
+                              f"({f.f_code.co_name})")
+                break
+        f = f.f_back
+        hops += 1
+    return " <- ".join(frames) if frames else None
+
+
+def _module_name(computation: Any) -> str:
+    """Best-effort name of the lowered MLIR module (``jit__round_fn``
+    etc.) — identifies WHAT recompiled without holding the module."""
+    try:
+        from jax._src.lib.mlir import ir
+
+        return ir.StringAttr(
+            computation.operation.attributes["sym_name"]).value
+    except Exception:  # noqa: BLE001 — any mlir shape change
+        return getattr(computation, "name", None) or "<unknown>"
+
+
+def _traced_compile(*args: Any, **kwargs: Any) -> Any:
+    computation = args[1] if len(args) > 1 else kwargs.get("computation")
+    t0 = time.perf_counter()
+    try:
+        return _saved["compile"](*args, **kwargs)
+    finally:
+        if _enabled:
+            with _state_lock:
+                phase = _phase
+                rec = {
+                    "module": _module_name(computation),
+                    "phase": phase,
+                    "seconds": round(time.perf_counter() - t0, 4),
+                    "site": _repo_site(2),
+                }
+                _records.append(rec)
+            from dmlc_core_tpu.base import metrics as _metrics
+
+            if _metrics.enabled():
+                _metrics.default_registry().counter(
+                    "recompiles_total",
+                    "XLA compilations observed by jitcheck, by phase "
+                    "(warmup|steady) — steady-state compiles fail drills",
+                    labels=("phase",)).inc(1, phase=phase)
+
+
+# -- lifecycle --------------------------------------------------------------
+
+def install() -> None:
+    """Patch the jax compile choke point and start recording.
+    Idempotent.  The original is captured here (not at import) so
+    repeated cycles restore the true entry point."""
+    global _enabled
+    if _enabled:
+        return
+    from jax._src import compiler as _compiler
+
+    _saved["compile"] = _compiler.compile_or_get_cached
+    _compiler.compile_or_get_cached = _traced_compile  # type: ignore
+    _enabled = True
+
+
+def uninstall() -> None:
+    """Stop recording and restore the jax entry point.  Idempotent."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    from jax._src import compiler as _compiler
+
+    _compiler.compile_or_get_cached = _saved["compile"]  # type: ignore
+    _saved.clear()
+
+
+def installed() -> bool:
+    """True while jitcheck is actively recording compilations."""
+    return _enabled
+
+
+# -- phase tagging ----------------------------------------------------------
+
+def steady() -> None:
+    """Declare warmup over: every compile from here on is a violation.
+    Call exactly where the bench/drill's steady state begins (stream
+    window full, routed warmup predict verified, ...)."""
+    global _phase
+    with _state_lock:
+        _phase = "steady"
+
+
+def warmup() -> None:
+    """Re-enter the warmup phase (a new model's first compile is
+    legitimate — e.g. between drill sections, or in tests)."""
+    global _phase
+    with _state_lock:
+        _phase = "warmup"
+
+
+def current_phase() -> str:
+    """The tag the next recorded compile will carry."""
+    return _phase
+
+
+# -- reporting --------------------------------------------------------------
+
+def compiles(phase: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Every recorded compilation (module, phase, seconds, site),
+    optionally filtered to one phase."""
+    with _state_lock:
+        recs = [dict(r) for r in _records]
+    if phase is not None:
+        recs = [r for r in recs if r["phase"] == phase]
+    return recs
+
+
+def reset() -> None:
+    """Forget every recorded compile and return to warmup (test
+    isolation)."""
+    global _phase
+    with _state_lock:
+        _records.clear()
+        _phase = "warmup"
+
+
+def check() -> None:
+    """Raise :class:`JitCompileError` when any compilation was recorded
+    after :func:`steady` — the zero-post-warmup-compiles gate."""
+    bad = compiles("steady")
+    if not bad:
+        return
+    lines = [f"{r['module']} ({r['seconds']}s) at "
+             f"{r['site'] or '<no repo frame>'}" for r in bad]
+    raise JitCompileError(
+        f"{len(bad)} steady-state XLA compilation(s): " + "; ".join(lines))
+
+
+def write_report(path: str) -> Dict[str, Any]:
+    """Archive the compile report as JSON (the drills' ``*_JITCHECK_OUT``
+    artifact); returns the report dict."""
+    import json
+
+    recs = compiles()
+    report = {
+        "enabled": _enabled,
+        "phase": _phase,
+        "compiles_total": len(recs),
+        "compiles_steady": sum(1 for r in recs if r["phase"] == "steady"),
+        "compiles": recs,
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    return report
+
+
+def env_enabled() -> bool:
+    """The ``DMLC_JITCHECK`` import-time gate."""
+    return os.environ.get("DMLC_JITCHECK", "0").lower() in (
+        "1", "true", "on", "yes", "raise")

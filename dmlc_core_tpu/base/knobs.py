@@ -130,6 +130,12 @@ declare("DMLC_LEAKCHECK", "0",
         "is recorded with its creation stack, and whatever is still "
         "live at drill exit is reported (base/leakcheck).",
         "observability")
+declare("DMLC_JITCHECK", "0",
+        "1 installs the XLA-compile tracer at import: every "
+        "compilation is recorded with its repo-frame stack and phase "
+        "tag (warmup/steady), and any compile after the bench/drill "
+        "declares steady state fails check() (base/jitcheck).",
+        "observability")
 declare("DMLC_INTERLEAVE_SCHEDULES", 200,
         "Schedule budget per model for the interleave model checker "
         "(analysis/interleave).", "observability")

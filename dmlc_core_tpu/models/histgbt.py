@@ -43,6 +43,7 @@ from dmlc_core_tpu.base.compat import donate_argnums, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.base import compile_cache as _cc
+from dmlc_core_tpu.base import knobs as _knobs
 from dmlc_core_tpu.base import metrics as _metrics
 from dmlc_core_tpu.base.logging import CHECK, CHECK_EQ, LOG, log_fatal
 from dmlc_core_tpu.base.parameter import Parameter, field, get_env
@@ -711,8 +712,10 @@ class HistGBT(_ExternalMemoryEngine):
         def after_chunk(done, preds_c, trees_k):
             if eval_bins is None:
                 return False
+            # trees_k is ONE dispatch chunk's stacked dict — wrap it as
+            # a single-chunk forest for the chunked _apply_trees
             state["eval_margin"] = self._apply_trees(
-                eval_bins, trees_k, state["eval_margin"])
+                eval_bins, [trees_k], state["eval_margin"])
             vloss = float(metric_fn(state["eval_margin"], yv_d))
             self.eval_history.append((n_prior + done, vloss))
             improved = (self.best_score is None
@@ -1834,9 +1837,9 @@ class HistGBT(_ExternalMemoryEngine):
                 p.min_child_weight,
                 p.hist_method, obj_key, mono, p.subsample,
                 p.colsample_bytree, p.num_class, self._missing,
-                os.environ.get("DMLC_TPU_FUSED_DESCEND", "0"),
-                os.environ.get("DMLC_FUSED_ROUND", "auto"),
-                os.environ.get("DMLC_HIST_QUANT", "0"),
+                _knobs.value("DMLC_TPU_FUSED_DESCEND"),
+                _knobs.value("DMLC_FUSED_ROUND"),
+                _knobs.value("DMLC_HIST_QUANT"),
                 _hist_blocks(int(self.mesh.shape["data"])),
                 _grow_policy(), _max_leaves(), self._bin_layout)
 
@@ -1895,7 +1898,7 @@ class HistGBT(_ExternalMemoryEngine):
         # two-pass descend+hist measured faster than the fused kernel on
         # v5e (see ops.fused_descend_histogram); env knob for other HW
         fuse_levels = bool(int(
-            os.environ.get("DMLC_TPU_FUSED_DESCEND", "0")))
+            _knobs.value("DMLC_TPU_FUSED_DESCEND")))
         # deterministic shard-invariant reduction (DMLC_HIST_BLOCKS, see
         # _hist_blocks): fixed global row blocks + fixed-order folds +
         # all_gather instead of psum, so the grown trees are
@@ -2625,7 +2628,11 @@ class HistGBT(_ExternalMemoryEngine):
         CHECK(len(self.trees) > 0, "no trees trained")
         depth = self.param.max_depth
         use = self._resolve_trees(n_trees)
-        stacked = self._stacked_trees(use)
+        # exact-count stack (not the padded chunks): the output is
+        # [n, T] leaf ids, so padded no-op trees would widen it
+        keys = ("feat", "thr") + (("dir",) if "dir" in use[0] else ())
+        stacked = {k: jnp.asarray(np.stack([t[k] for t in use]))
+                   for k in keys}
         X = np.ascontiguousarray(X, dtype=np.float32)
         self._check_nan_allowed(X, "predict_leaf")
         if len(X) == 0:
@@ -2693,31 +2700,62 @@ class HistGBT(_ExternalMemoryEngine):
         return (n, K) if K > 1 else (n,)
 
     @staticmethod
-    def _stacked_trees(trees: List[Dict[str, np.ndarray]]) -> Dict[str, jax.Array]:
+    def _stacked_trees(trees: List[Dict[str, np.ndarray]]
+                       ) -> List[Dict[str, jax.Array]]:
+        """Device forest as fixed-shape chunks of ``_TREE_CHUNK`` trees
+        (last chunk zero-padded at host level).
+
+        The compiled ``_predict_trees`` program is keyed on the forest
+        array's shape — stacking the EXACT tree count meant a growing
+        online model recompiled the predict/margin-replay program on
+        every stream refresh (jitcheck's steady-state bug class, the
+        same stall shape as the PR 18 warmup miss).  A padded tree is
+        all zeros, so its ``leaf[node]`` contribution is exactly 0.0 —
+        margins are unchanged while every forest size ≤ the chunk
+        multiple shares one compiled program per batch shape."""
         keys = ("feat", "thr", "leaf") + (
             ("dir",) if "dir" in trees[0] else ())
-        return {k: jnp.asarray(np.stack([t[k] for t in trees]))
-                for k in keys}
+        chunks: List[Dict[str, jax.Array]] = []
+        for lo in range(0, len(trees), _TREE_CHUNK):
+            part = trees[lo:lo + _TREE_CHUNK]
+            stacked = {k: np.stack([t[k] for t in part]) for k in keys}
+            pad = _TREE_CHUNK - len(part)
+            if pad:
+                stacked = {
+                    k: np.concatenate(
+                        [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                    for k, v in stacked.items()
+                }
+            chunks.append({k: jnp.asarray(v) for k, v in stacked.items()})
+        return chunks
 
     def _apply_trees(self, bins, stacked, init):
-        """Add the stacked trees' margins onto ``init`` ([n] or [n, K])."""
+        """Add the chunked forest's margins onto ``init`` ([n] or
+        [n, K]) — one fixed-shape ``_predict_trees`` dispatch per chunk,
+        margins threaded through so summation order matches the
+        incremental updates that built them."""
         depth = self.param.max_depth
         miss = self._miss_bin()
-        dirs = stacked.get("dir")
-        if stacked["feat"].ndim == 4:      # multiclass: [T, K, depth, half]
-            cols = [
-                _predict_trees(bins, stacked["feat"][:, c],
-                               stacked["thr"][:, c],
-                               stacked["leaf"][:, c], depth, 0.0,
-                               init[:, c],
-                               dirs[:, c] if dirs is not None else None,
-                               miss)
-                for c in range(stacked["feat"].shape[1])
-            ]
-            return jnp.stack(cols, axis=1)
-        return _predict_trees(bins, stacked["feat"], stacked["thr"],
-                              stacked["leaf"], depth, 0.0, init,
-                              dirs, miss)
+        margin = init
+        for chunk in stacked:
+            dirs = chunk.get("dir")
+            if chunk["feat"].ndim == 4:    # multiclass: [T, K, depth, half]
+                cols = [
+                    _predict_trees(bins,
+                                   chunk["feat"][:, c],
+                                   chunk["thr"][:, c],
+                                   chunk["leaf"][:, c], depth, 0.0,
+                                   margin[:, c],
+                                   dirs[:, c] if dirs is not None else None,
+                                   miss)
+                    for c in range(chunk["feat"].shape[1])
+                ]
+                margin = jnp.stack(cols, axis=1)
+            else:
+                margin = _predict_trees(bins, chunk["feat"], chunk["thr"],
+                                        chunk["leaf"], depth, 0.0, margin,
+                                        dirs, miss)
+        return margin
 
     # ------------------------------------------------------------------
     # persistence & introspection
@@ -2897,6 +2935,12 @@ class HistGBT(_ExternalMemoryEngine):
                     else:
                         np.add.at(out, feat[real], 1)
         return out
+
+
+#: trees per compiled predict/margin-replay program (``_stacked_trees``
+#: pads forests to a multiple of this) — the program's shape must not
+#: track ensemble size, or every online refresh recompiles it
+_TREE_CHUNK = 64
 
 
 def _descend_step(bins, feat, thr, dirv, node, miss_bin):

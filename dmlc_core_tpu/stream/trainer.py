@@ -19,12 +19,24 @@ with ``window_chunks=1, decay=1.0`` fed chunks A then B produces
 same parameterization — online learning is exactly repeated continued
 fits, not a new training algorithm.
 
-Compile behavior: refreshes deliberately keep shapes stable.  The window
-grows chunk by chunk until it holds ``window_chunks`` chunks and then
-stays at that row count forever, so after the first ``window_chunks``
-refreshes every ``fit`` re-dispatches the already-compiled (and AOT/
+Compile behavior: refreshes deliberately keep shapes stable.  A refresh
+only fits on a **full** chunk of exactly ``chunk_rows`` rows — a partial
+gather (timeout/stop mid-chunk) stays in a pending buffer, counts toward
+the next refresh, and ``refresh`` returns ``None``, so every chunk in
+the window has the same row count by construction.  The window grows
+chunk by chunk until it holds ``window_chunks`` chunks and then stays at
+that row count forever: after the first ``window_chunks`` refreshes
+every ``fit`` re-dispatches the already-compiled (and AOT/
 persistent-cache warmed — doc/performance.md) round programs with zero
-trace/compile work.  Steady-state refresh cost is boost + publish only.
+trace/compile work.  Steady-state refresh cost is boost + publish only
+— ``DMLC_JITCHECK=1`` (base/jitcheck) verifies exactly this in
+``bench.py --stream`` / ``--prodsim``; before the full-chunk policy a
+timeout-starved partial window (591 rows instead of 1024) recompiled
+the whole round-program set mid-stream.  Pending rows are consumed from
+the tailer but **uncommitted** (commits only happen on a fitting
+refresh), so a crash replays them — at-least-once is preserved.  A
+finite stream's partial tail can be trained explicitly with
+:meth:`~OnlineTrainer.flush`.
 
 Each refresh optionally flows through a :class:`~dmlc_core_tpu.stream.
 publisher.ModelPublisher` (staged registry publish, holdout eval gate,
@@ -116,6 +128,9 @@ class OnlineTrainer:
         self.commit_cursor = commit_cursor
         self._window: Deque[Tuple[np.ndarray, np.ndarray]] = deque(
             maxlen=self.window_chunks)
+        #: records gathered but short of a full chunk — consumed from
+        #: the tailer, not yet trained on, not yet committed
+        self._pending: List[bytes] = []
         self.refreshes = 0
         self.last_refresh: Optional[Dict[str, Any]] = None
 
@@ -143,14 +158,32 @@ class OnlineTrainer:
     def refresh(self, timeout: Optional[float] = None,
                 stop: Optional[Callable[[], bool]] = None
                 ) -> Optional[Dict[str, Any]]:
-        """One refresh: gather ≥ 1 fresh records (up to ``chunk_rows``,
-        bounded by ``timeout``), boost, publish, commit.  Returns a
-        summary dict, or None when no records arrived (timeout/stop)."""
+        """One refresh: gather fresh records until a full chunk of
+        exactly ``chunk_rows`` exists (bounded by ``timeout``), boost,
+        publish, commit.  A partial gather stays pending for the next
+        call — fixed fit shapes — and returns None, as does an empty
+        one (timeout/stop)."""
         t0 = time.monotonic()
-        records = self.tailer.wait_records(self.chunk_rows,
-                                           timeout=timeout, stop=stop)
-        if not records:
+        got = self.tailer.wait_records(
+            self.chunk_rows - len(self._pending),
+            timeout=timeout, stop=stop)
+        self._pending.extend(got)
+        if len(self._pending) < self.chunk_rows:
             return None
+        records, self._pending = self._pending, []
+        return self._fit_chunk(records, t0)
+
+    def flush(self) -> Optional[Dict[str, Any]]:
+        """Train on the pending partial chunk (finite-stream tail).
+        The fit shape is off-grid, so under ``DMLC_JITCHECK=1`` call
+        this before ``steady()`` or accept the recompile."""
+        if not self._pending:
+            return None
+        records, self._pending = self._pending, []
+        return self._fit_chunk(records, time.monotonic())
+
+    def _fit_chunk(self, records: List[bytes],
+                   t0: float) -> Dict[str, Any]:
         X, y = self._decode(records)
         self._window.append((X, y))
         Xw, yw, ww = self._window_matrix()

@@ -24,7 +24,10 @@ verifies zero lock-order cycles AND zero happens-before races across
 the whole drill; the racecheck report is archived to
 ``FLEET_RACECHECK_OUT`` (default ``/tmp/fleet_racecheck.json``), and
 ``DMLC_LEAKCHECK=1`` gates GREEN on zero live resource leaks at exit
-(``FLEET_LEAKCHECK_OUT``, default ``/tmp/fleet_leakcheck.json``).
+(``FLEET_LEAKCHECK_OUT``, default ``/tmp/fleet_leakcheck.json``), and
+``DMLC_JITCHECK=1`` gates GREEN on zero steady-state XLA compiles after
+the routed warmup predict (``FLEET_JITCHECK_OUT``, default
+``/tmp/fleet_jitcheck.json``).
 Exit 0 = drill green.  Usage:
     python scripts/check_fleet.py
 """
@@ -64,6 +67,7 @@ def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
     os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    os.environ.setdefault("DMLC_JITCHECK", "1")
     # observability plane: every process (parent router, replicas,
     # loadgen workers) spools metrics + trace shards into one directory
     os.environ.setdefault("DMLC_TRACE", "1")
@@ -77,8 +81,8 @@ def main() -> None:
 
     import numpy as np
 
-    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
-                                    racecheck, slo)
+    from dmlc_core_tpu.base import (jitcheck, leakcheck, lockcheck,
+                                    metrics_agg, racecheck, slo)
     from dmlc_core_tpu.models import HistGBT
     from dmlc_core_tpu.serve import checkpoint_model
     from dmlc_core_tpu.serve.fleet import (FleetRouter, FleetTracker,
@@ -127,6 +131,11 @@ def main() -> None:
         preds, ver = client.predict(X[:8])
         _check(ver == 1 and np.array_equal(preds, m1.predict(X)[:8]),
                "routed predict bit-identical to direct v1 predict")
+        # the parent's jax work (oracle fits + predicts above) ends here;
+        # everything that follows is HTTP/subprocess/loadgen — any further
+        # XLA compile in this process is a steady-state stall and fails
+        # jitcheck.check() below
+        jitcheck.steady()
 
         def _loadgen_bg(result, duration):
             result.update(run_loadgen(
@@ -293,6 +302,12 @@ def main() -> None:
     leakcheck.check()
     print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
           f"(parent; report at {lk_out})")
+    jc_out = os.environ.get("FLEET_JITCHECK_OUT",
+                            "/tmp/fleet_jitcheck.json")
+    jc_report = jitcheck.write_report(jc_out)
+    jitcheck.check()
+    print(f"ok: zero steady-state XLA compiles under DMLC_JITCHECK=1 "
+          f"(parent; report at {jc_out})")
 
     # -- SLO scorecard gate ----------------------------------------------
     spec_path = os.environ.get("FLEET_SLO_SPEC") or os.path.join(
@@ -301,6 +316,7 @@ def main() -> None:
         "loadgen": report["phases"]["rollout"]["load"],
         "racecheck": {"races": len(rc_report["races"])},
         "leakcheck": {"leaks": len(lk_report["leaks"])},
+        "jitcheck": {"recompiles_steady": jc_report["compiles_steady"]},
     }
     scorecard = slo.evaluate(slo.SLOSpec.load(spec_path), merged, evidence)
     slo_out = os.environ.get("FLEET_SLO_OUT", "/tmp/fleet_slo.json")

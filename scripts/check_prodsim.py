@@ -23,8 +23,10 @@ tenant rolling back, zero lock-order cycles / races / leaks, and the
 committed SLO scorecard ``scripts/slo/prodsim.json`` passing end to
 end.  Artifacts: report at ``PRODSIM_OUT``, merged metrics at
 ``PRODSIM_METRICS_OUT``, stitched trace at ``PRODSIM_TRACE_OUT``,
-race/leak reports at ``PRODSIM_RACECHECK_OUT`` /
-``PRODSIM_LEAKCHECK_OUT``, scorecard at ``PRODSIM_SLO_OUT``.
+race/leak/jit reports at ``PRODSIM_RACECHECK_OUT`` /
+``PRODSIM_LEAKCHECK_OUT`` / ``PRODSIM_JITCHECK_OUT`` (the latter gates
+zero steady-state XLA compiles in the stream lane's steady window),
+scorecard at ``PRODSIM_SLO_OUT``.
 Exit 0 = drill green.  Usage:
     python scripts/check_prodsim.py
 """
@@ -50,6 +52,7 @@ def main() -> None:
     os.environ.setdefault("DMLC_LOCKCHECK", "1")
     os.environ.setdefault("DMLC_RACECHECK", "1")
     os.environ.setdefault("DMLC_LEAKCHECK", "1")
+    os.environ.setdefault("DMLC_JITCHECK", "1")
     os.environ.setdefault("DMLC_TRACE", "1")
     os.environ.setdefault("BENCH_FORCE_CPU", "1")
     spool = os.environ.get("DMLC_METRICS_SPOOL") \
@@ -60,8 +63,8 @@ def main() -> None:
 
     force_cpu_devices(1)
 
-    from dmlc_core_tpu.base import (leakcheck, lockcheck, metrics_agg,
-                                    racecheck, slo)
+    from dmlc_core_tpu.base import (jitcheck, leakcheck, lockcheck,
+                                    metrics_agg, racecheck, slo)
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import trace_collect
@@ -163,6 +166,12 @@ def main() -> None:
     leakcheck.check()
     print(f"ok: zero live resource leaks under DMLC_LEAKCHECK=1 "
           f"(parent; report at {lk_out})")
+    jc_out = os.environ.get("PRODSIM_JITCHECK_OUT",
+                            "/tmp/prodsim_jitcheck.json")
+    jc_report = jitcheck.write_report(jc_out)
+    jitcheck.check()
+    print(f"ok: zero steady-state XLA compiles under DMLC_JITCHECK=1 "
+          f"(stream lane steady window; report at {jc_out})")
 
     # -- the ONE SLO scorecard gate ---------------------------------------
     spec_path = os.environ.get("PRODSIM_SLO_SPEC") or os.path.join(
@@ -170,6 +179,8 @@ def main() -> None:
     evidence = dict(record)
     evidence["racecheck"] = {"races": len(rc_report["races"])}
     evidence["leakcheck"] = {"leaks": len(lk_report["leaks"])}
+    evidence["jitcheck"] = {
+        "recompiles_steady": jc_report["compiles_steady"]}
     scorecard = slo.evaluate(slo.SLOSpec.load(spec_path), merged, evidence)
     slo_out = os.environ.get("PRODSIM_SLO_OUT", "/tmp/prodsim_slo.json")
     with open(slo_out, "w") as f:

@@ -19,11 +19,13 @@ echo "== hygiene =="
 rm -rf build/lib build/bdist.* ./*.egg-info
 
 echo "== dmlcheck =="
-# project-aware static analysis (lock discipline, jit purity, knob /
-# metric registries, resource/thread lifecycles, collective
+# project-aware static analysis (lock discipline, jit purity, the jax
+# trio — recompile-hazard / donation-discipline / transfer-discipline —
+# knob / metric registries, resource/thread lifecycles, collective
 # discipline, wire schemas, style) over one AST parse per file; runs
-# in BOTH lanes (quick included), budgeted <= 10s over the whole repo,
-# and the JSON report is archived like bench metrics.
+# in BOTH lanes (quick included), budgeted <= 10s over the whole repo
+# (the incremental cache at scripts/.dmlcheck_cache keeps warm re-runs
+# under 2s), and the JSON report is archived like bench metrics.
 # doc/static_analysis.md documents passes, suppressions and the
 # baseline workflow.
 DMLCHECK_OUT="${DMLCHECK_OUT:-/tmp/dmlcheck.json}"
@@ -151,9 +153,10 @@ echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
 # rollout under load must keep per-replica versions monotone and land
 # the whole fleet on v2 — still zero dropped / zero wrong.  The JSON
 # report is archived; parent runs under DMLC_LOCKCHECK=1 +
-# DMLC_RACECHECK=1 + DMLC_LEAKCHECK=1 with zero order cycles, zero
-# happens-before races and zero live resource leaks at exit; the
-# racecheck and leakcheck JSON are archived alongside
+# DMLC_RACECHECK=1 + DMLC_LEAKCHECK=1 + DMLC_JITCHECK=1 with zero order
+# cycles, zero happens-before races, zero live resource leaks and zero
+# steady-state XLA compiles at exit; the racecheck, leakcheck and
+# jitcheck JSON are archived alongside
 # (doc/serving.md "Fleet serving").
 # The observability plane rides the same run: every process spools its
 # metrics + trace shard, the drill merges them (exact counter sums,
@@ -163,6 +166,7 @@ echo "== fleet serving chaos drill (kill / reroute / rescale / rollout) =="
 env JAX_PLATFORMS=cpu \
     FLEET_RACECHECK_OUT="${FLEET_RACECHECK_OUT:-/tmp/fleet_racecheck.json}" \
     FLEET_LEAKCHECK_OUT="${FLEET_LEAKCHECK_OUT:-/tmp/fleet_leakcheck.json}" \
+    FLEET_JITCHECK_OUT="${FLEET_JITCHECK_OUT:-/tmp/fleet_jitcheck.json}" \
     FLEET_METRICS_OUT="${FLEET_METRICS_OUT:-/tmp/fleet_metrics.json}" \
     FLEET_TRACE_OUT="${FLEET_TRACE_OUT:-/tmp/fleet_trace.json}" \
     FLEET_SLO_OUT="${FLEET_SLO_OUT:-/tmp/fleet_slo.json}" \
@@ -259,7 +263,8 @@ echo "== production-day simulation (whole-stack chaos, one SLO scorecard) =="
 # shard bytes (tailer resync), and a poisoned tenant publish (eval gate
 # rollback, tenant-scoped).  GREEN gates on >= 99% availability with
 # zero dropped / zero wrong, cause-fair respawn budgets, zero
-# lock/race/leak findings, and the ONE committed SLO scorecard
+# lock/race/leak findings, zero steady-state XLA compiles in the
+# stream lane (DMLC_JITCHECK), and the ONE committed SLO scorecard
 # scripts/slo/prodsim.json (doc/robustness.md "Production-day
 # simulation").  CI runs the smoke window; the archived PRODSIM_r0*.json
 # evidence chain uses the full DMLC_PRODSIM_SECONDS default.
@@ -268,6 +273,7 @@ env JAX_PLATFORMS=cpu \
     PRODSIM_OUT="${PRODSIM_OUT:-/tmp/prodsim_drill.json}" \
     PRODSIM_RACECHECK_OUT="${PRODSIM_RACECHECK_OUT:-/tmp/prodsim_racecheck.json}" \
     PRODSIM_LEAKCHECK_OUT="${PRODSIM_LEAKCHECK_OUT:-/tmp/prodsim_leakcheck.json}" \
+    PRODSIM_JITCHECK_OUT="${PRODSIM_JITCHECK_OUT:-/tmp/prodsim_jitcheck.json}" \
     PRODSIM_METRICS_OUT="${PRODSIM_METRICS_OUT:-/tmp/prodsim_metrics.json}" \
     PRODSIM_TRACE_OUT="${PRODSIM_TRACE_OUT:-/tmp/prodsim_trace.json}" \
     PRODSIM_SLO_OUT="${PRODSIM_SLO_OUT:-/tmp/prodsim_slo.json}" \

@@ -108,6 +108,15 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write current findings as the new baseline "
                          "and exit 0")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="force a full parse + full pass run, ignoring "
+                         "and not writing the incremental cache (the "
+                         "repo-must-be-clean test uses this)")
+    ap.add_argument("--cache", default=None, metavar="PATH",
+                    help="incremental cache file (default <root>/"
+                         "scripts/.dmlcheck_cache); per-file (mtime, "
+                         "size)-keyed parses plus whole-run finding "
+                         "reuse when nothing changed")
     ap.add_argument("--root", default=ROOT, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
@@ -115,8 +124,11 @@ def main(argv=None) -> int:
         return _explain(args.explain)
 
     rules = args.rules.split(",") if args.rules else None
+    cache_path = None if args.no_cache else (
+        args.cache or os.path.join(args.root, "scripts",
+                                   ".dmlcheck_cache"))
     t0 = time.perf_counter()
-    ctx = analyze(args.root, rules=rules)
+    ctx = analyze(args.root, rules=rules, cache_path=cache_path)
     elapsed = time.perf_counter() - t0
 
     if args.write_baseline:
@@ -155,6 +167,13 @@ def main(argv=None) -> int:
               + ", ".join(f"{n} {ctx.pass_seconds[n]:.2f}s"
                           for n in order),
               file=sys.stderr)
+        if ctx.cache_stats:
+            cs = ctx.cache_stats
+            rate = cs["hits"] / cs["files"] if cs["files"] else 0.0
+            print(f"dmlcheck: cache: {cs['hits']}/{cs['files']} parse "
+                  f"hits ({rate:.0%}), findings "
+                  f"{'reused' if cs['findings_reused'] else 'recomputed'}",
+                  file=sys.stderr)
 
     if args.json_out:
         report = {
@@ -171,6 +190,7 @@ def main(argv=None) -> int:
             "stale_baseline": sorted(stale),
             "pass_seconds": {k: round(v, 4)
                              for k, v in ctx.pass_seconds.items()},
+            "cache": ctx.cache_stats or None,
         }
         d = os.path.dirname(os.path.abspath(args.json_out))
         if d:

@@ -1023,3 +1023,269 @@ def test_cli_timings_reports_new_passes(tmp_path):
     assert "per-pass timings" in r.stderr
     assert "blocking" in r.stderr and "atomicity" in r.stderr
     assert "resources" in r.stderr and "protocol" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard (analysis/jaxpass)
+# ---------------------------------------------------------------------------
+
+_RECOMPILE_BAD = """
+    import os
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def kernel(x, cfg):
+        return x
+
+    class Model:
+        def step(self, x):
+            return jax.jit(self._impl)(x)       # fresh wrapper per call
+
+        def steps(self, xs):
+            fns = []
+            for x in xs:
+                fns.append(jax.jit(self._impl)) # rebuilt per iteration
+            return fns
+
+        def predict(self, x):
+            return kernel(x, f"k-{x.shape}")    # fresh static key per call
+
+        def _round_fn_cache_key(self):
+            return (os.environ.get("DMLC_FIXTURE_FLAG", "0"),)
+"""
+
+_RECOMPILE_GOOD = """
+    import jax
+    from functools import partial
+
+    _EXEC_CACHE = {}
+
+    @partial(jax.jit, static_argnums=(1,))
+    def kernel(x, depth):
+        return x
+
+    class Model:
+        def __init__(self):
+            self._impl_jit = jax.jit(self._impl)   # built once
+
+        def step(self, x):
+            return self._impl_jit(x)
+
+        def warm(self, shapes):
+            for s in shapes:
+                _EXEC_CACHE[s] = jax.jit(self._impl)  # parked in a cache
+
+        def predict(self, x, depth):
+            return kernel(x, depth)                # hashable static
+
+        def _round_fn_cache_key(self):
+            return (knobs.value("DMLC_FIXTURE_FLAG"),)
+"""
+
+
+def test_recompile_hazard_flags_unstable_shapes(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _RECOMPILE_BAD},
+                             knobs=["DMLC_FIXTURE_FLAG"]),
+                  rules=["recompile-hazard"])
+    msgs = [f.message for f in _findings(ctx, "recompile-hazard")]
+    assert any("fresh jax.jit wrapper per call" in m for m in msgs), msgs
+    assert any("inside a loop" in m for m in msgs), msgs
+    assert any("static position 1" in m for m in msgs), msgs
+    assert any("compile-cache key" in m and "knobs" in m
+               for m in msgs), msgs
+
+
+def test_recompile_hazard_clean_idioms(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _RECOMPILE_GOOD},
+                             knobs=["DMLC_FIXTURE_FLAG"]),
+                  rules=["recompile-hazard"])
+    assert _findings(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# donation-discipline (analysis/jaxpass)
+# ---------------------------------------------------------------------------
+
+_DONATION_BAD = """
+    import jax
+
+    def update(state, grads):
+        return state
+
+    step = jax.jit(update, donate_argnums=(0,))   # ungated literal
+
+    def train(state, grads):
+        new = step(state, grads)
+        print(state)                              # read after donation
+        return new
+"""
+
+_DONATION_GOOD = """
+    import jax
+    from dmlc_core_tpu.base.compat import donate_argnums
+
+    def update(state, grads):
+        return state
+
+    step = jax.jit(update, donate_argnums=donate_argnums(0))
+
+    def train(state, grads):
+        state = step(state, grads)     # rebinding kills the old name
+        return state
+"""
+
+
+def test_donation_discipline_flags_ungated_and_use_after(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _DONATION_BAD}),
+                  rules=["donation-discipline"])
+    msgs = [f.message for f in _findings(ctx, "donation-discipline")]
+    assert any("base/compat.py gate" in m for m in msgs), msgs
+    assert any("reads 'state' after donating" in m for m in msgs), msgs
+
+
+def test_donation_discipline_clean_gated_and_rebound(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _DONATION_GOOD}),
+                  rules=["donation-discipline"])
+    assert _findings(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# transfer-discipline (analysis/jaxpass)
+# ---------------------------------------------------------------------------
+
+_TRANSFER_BAD = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def kernel(x):
+        return np.asarray(x).sum()      # host transfer inside trace
+
+    round_fn = jax.jit(lambda p: p)
+
+    def fit(preds, table, n):
+        done = 0
+        while done < n:
+            cfg = jax.device_put(table)   # re-uploaded per round
+            preds = round_fn(preds)
+            done += preds.item()          # device sync per round
+        return preds
+"""
+
+_TRANSFER_GOOD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(x):
+        return jnp.asarray(x).sum()
+
+    round_fn = jax.jit(lambda p: p)
+
+    def fit(preds, table, n):
+        cfg = jax.device_put(table)       # ingest: once, outside
+        for _ in range(n):
+            preds = round_fn(jax.device_put(preds))  # feeding the call
+        return float(preds.sum())          # one sync after the loop
+"""
+
+
+def test_transfer_discipline_flags_traced_and_roundloop(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _TRANSFER_BAD}),
+                  rules=["transfer-discipline"])
+    msgs = [f.message for f in _findings(ctx, "transfer-discipline")]
+    assert any("np.asarray" in m for m in msgs), msgs
+    assert any("device_put inside its round loop" in m for m in msgs), msgs
+    assert any(".item() inside its round loop" in m for m in msgs), msgs
+
+
+def test_transfer_discipline_clean_ingest_and_jnp(tmp_path):
+    ctx = analyze(_mini_repo(tmp_path,
+                             {"dmlc_core_tpu/mod.py": _TRANSFER_GOOD}),
+                  rules=["transfer-discipline"])
+    assert _findings(ctx) == []
+
+
+def test_jax_rule_help_has_doc_and_example_pair():
+    from dmlc_core_tpu.analysis import rule_help
+
+    for rule in ("recompile-hazard", "donation-discipline",
+                 "transfer-discipline"):
+        info = rule_help(rule)
+        assert info["rule"] == rule
+        assert info["doc"] and info["flagged"] and info["clean"]
+
+
+# ---------------------------------------------------------------------------
+# incremental cache
+# ---------------------------------------------------------------------------
+
+def test_cache_full_hit_reuses_findings(tmp_path):
+    root = _mini_repo(tmp_path,
+                      {"dmlc_core_tpu/mod.py": _DONATION_BAD})
+    cache = tmp_path / "cache.bin"
+    ctx1 = analyze(root, rules=["donation-discipline"],
+                   cache_path=str(cache))
+    assert ctx1.cache_stats == {"files": len(ctx1.files), "hits": 0,
+                                "findings_reused": False}
+    assert cache.exists()
+    ctx2 = analyze(root, rules=["donation-discipline"],
+                   cache_path=str(cache))
+    assert ctx2.cache_stats["hits"] == ctx2.cache_stats["files"]
+    assert ctx2.cache_stats["findings_reused"] is True
+    assert [f.fingerprint for f in ctx2.findings] == \
+        [f.fingerprint for f in ctx1.findings]
+    assert ctx2.suppressed_count == ctx1.suppressed_count
+
+
+def test_cache_invalidates_on_edit_and_rule_change(tmp_path):
+    root = _mini_repo(tmp_path,
+                      {"dmlc_core_tpu/mod.py": _DONATION_BAD,
+                       "dmlc_core_tpu/other.py": "x = 1\n"})
+    cache = tmp_path / "cache.bin"
+    analyze(root, rules=["donation-discipline"], cache_path=str(cache))
+    # a rules change must not reuse the previous run's findings
+    ctx_r = analyze(root, rules=["style"], cache_path=str(cache))
+    assert ctx_r.cache_stats["findings_reused"] is False
+    assert _findings(ctx_r, "donation-discipline") == []
+    # an edited file re-parses (one miss), findings recompute
+    analyze(root, rules=["donation-discipline"], cache_path=str(cache))
+    mod = os.path.join(root, "dmlc_core_tpu", "mod.py")
+    with open(mod, "a") as f:
+        f.write("\nY = 2\n")
+    ctx3 = analyze(root, rules=["donation-discipline"],
+                   cache_path=str(cache))
+    assert ctx3.cache_stats["findings_reused"] is False
+    assert ctx3.cache_stats["hits"] == ctx3.cache_stats["files"] - 1
+    assert _findings(ctx3, "donation-discipline")
+
+
+def test_cache_corrupt_file_is_cold_run(tmp_path):
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": "x = 1\n"})
+    cache = tmp_path / "cache.bin"
+    cache.write_bytes(b"not a pickle")
+    ctx = analyze(root, cache_path=str(cache))
+    assert ctx.cache_stats["findings_reused"] is False
+    assert ctx.findings == []
+
+
+def test_cli_no_cache_and_hit_rate(tmp_path):
+    root = _mini_repo(tmp_path, {"dmlc_core_tpu/mod.py": "x = 1\n"})
+    os.makedirs(os.path.join(root, "scripts"), exist_ok=True)
+    bp = tmp_path / "baseline.json"
+    r1 = _run_cli(["--root", root, "--baseline", str(bp), "--timings"])
+    assert r1.returncode == 0
+    assert "cache:" in r1.stderr and "findings recomputed" in r1.stderr
+    r2 = _run_cli(["--root", root, "--baseline", str(bp), "--timings"])
+    assert "findings reused" in r2.stderr
+    assert "(100%)" in r2.stderr
+    r3 = _run_cli(["--root", root, "--baseline", str(bp), "--timings",
+                   "--no-cache"])
+    assert r3.returncode == 0
+    assert "cache:" not in r3.stderr

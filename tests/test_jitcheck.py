@@ -1,0 +1,167 @@
+"""jitcheck (dynamic XLA-compile tracer) contracts.
+
+The static ``recompile-hazard`` pass proves cache keys are stable
+shapes; these tests prove the dynamic half: every compilation is
+recorded with its phase tag and repo call site, a compile seeded after
+``steady()`` raises :class:`JitCompileError` with an actionable stack,
+warmup compiles never fail ``check()``, install/uninstall cycles
+restore the true jax entry point, and with the env gate off nothing is
+patched at all.
+
+This file lives under tests/ on purpose: the recorded site must name
+the repo frame that triggered the compile, and the test file IS the
+repo frame.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dmlc_core_tpu.base import jitcheck
+
+
+@pytest.fixture
+def traced():
+    installed_before = jitcheck.installed()
+    if not installed_before:
+        jitcheck.install()
+    jitcheck.reset()
+    yield
+    jitcheck.reset()
+    if not installed_before:
+        jitcheck.uninstall()
+
+
+def _fresh_compile(salt: float) -> None:
+    """Force one real XLA compilation: a brand-new jitted closure is
+    never in jax's in-process jit cache, whatever earlier tests ran."""
+    fn = jax.jit(lambda x: x * salt + salt)
+    fn(jnp.arange(4.0)).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# the seeded violation: a compile after steady() fails check()
+# ---------------------------------------------------------------------------
+
+def test_seeded_steady_compile_raises_with_repo_site(traced):
+    _fresh_compile(2.0)                       # legitimate warmup compile
+    jitcheck.steady()
+    _fresh_compile(3.0)                       # the seeded violation
+    bad = jitcheck.compiles("steady")
+    assert len(bad) == 1, bad
+    # the site must name THIS file and the seeding helper — that's
+    # what makes a steady-state stall actionable from the drill log
+    assert "tests/test_jitcheck.py" in bad[0]["site"]
+    assert "(_fresh_compile)" in bad[0]["site"]
+    assert bad[0]["seconds"] >= 0
+    with pytest.raises(jitcheck.JitCompileError,
+                       match="steady-state XLA compilation"):
+        jitcheck.check()
+
+
+def test_warmup_compiles_are_exempt(traced):
+    _fresh_compile(5.0)
+    _fresh_compile(7.0)
+    assert jitcheck.current_phase() == "warmup"
+    recs = jitcheck.compiles()
+    assert len(recs) >= 2
+    assert all(r["phase"] == "warmup" for r in recs)
+    jitcheck.steady()
+    jitcheck.check()                          # no steady records: silent
+
+
+def test_warmup_reentry_between_sections(traced):
+    jitcheck.steady()
+    jitcheck.warmup()                         # new drill section begins
+    _fresh_compile(11.0)
+    jitcheck.steady()
+    jitcheck.check()                          # that compile was warmup
+
+
+# ---------------------------------------------------------------------------
+# report artifact (the drills' *_JITCHECK_OUT JSON)
+# ---------------------------------------------------------------------------
+
+def test_write_report_counts_phases(traced, tmp_path):
+    _fresh_compile(13.0)
+    jitcheck.steady()
+    _fresh_compile(17.0)
+    out = tmp_path / "jitcheck.json"
+    report = jitcheck.write_report(str(out))
+    assert report["enabled"] is True
+    assert report["phase"] == "steady"
+    assert report["compiles_steady"] == 1
+    assert report["compiles_total"] >= 2
+    on_disk = json.loads(out.read_text())
+    assert on_disk["compiles_steady"] == 1
+    assert on_disk["compiles"][0]["module"]
+
+
+def test_reset_clears_records_and_phase(traced):
+    _fresh_compile(19.0)
+    jitcheck.steady()
+    jitcheck.reset()
+    assert jitcheck.compiles() == []
+    assert jitcheck.current_phase() == "warmup"
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: idempotent cycles restore the true entry point
+# ---------------------------------------------------------------------------
+
+def test_install_uninstall_idempotent_and_restoring():
+    from jax._src import compiler as _compiler
+
+    original = _compiler.compile_or_get_cached
+    was_installed = jitcheck.installed()
+    if was_installed:
+        jitcheck.uninstall()
+        original = _compiler.compile_or_get_cached
+    try:
+        jitcheck.install()
+        patched = _compiler.compile_or_get_cached
+        assert patched is not original
+        jitcheck.install()                    # second install: no-op
+        assert _compiler.compile_or_get_cached is patched
+        jitcheck.uninstall()
+        assert _compiler.compile_or_get_cached is original
+        jitcheck.uninstall()                  # second uninstall: no-op
+        assert _compiler.compile_or_get_cached is original
+        # a full second cycle must save/restore the TRUE entry point,
+        # not a stale wrapper from the first cycle
+        jitcheck.install()
+        jitcheck.uninstall()
+        assert _compiler.compile_or_get_cached is original
+    finally:
+        if was_installed and not jitcheck.installed():
+            jitcheck.install()
+
+
+# ---------------------------------------------------------------------------
+# env gate off: nothing is patched, dispatch runs untouched
+# ---------------------------------------------------------------------------
+
+def test_env_gate_off_means_no_patch(monkeypatch):
+    monkeypatch.delenv("DMLC_JITCHECK", raising=False)
+    assert jitcheck.env_enabled() is False
+    if not jitcheck.installed():
+        from jax._src import compiler as _compiler
+
+        # the gate was off at import, so the entry point is jax's own
+        assert _compiler.compile_or_get_cached is not jitcheck._traced_compile
+        before = len(jitcheck.compiles())
+        _fresh_compile(23.0)
+        assert len(jitcheck.compiles()) == before
+
+
+@pytest.mark.parametrize("val,expect", [
+    ("1", True), ("true", True), ("on", True), ("raise", True),
+    ("0", False), ("off", False), ("", False),
+])
+def test_env_enabled_parsing(monkeypatch, val, expect):
+    monkeypatch.setenv("DMLC_JITCHECK", val)
+    assert jitcheck.env_enabled() is expect

@@ -353,6 +353,41 @@ class TestWarmStartParity:
         np.testing.assert_array_equal(manual2.predict(Xq),
                                       online.predict(Xq))
 
+    def test_partial_chunk_held_not_fitted(self, tmp_path):
+        """Fixed fit shapes: a timeout-starved partial gather never
+        trains (it recompiled the whole round-program set mid-stream
+        before the full-chunk policy) — it stays pending, uncommitted,
+        completes into the next full chunk, and flush() trains a
+        finite stream's tail explicitly."""
+        XA, yA = _make_xy(256, 61)
+        shard = os.path.join(tmp_path, "events.rec")
+        _write_events(shard, XA[:100], yA[:100], mode="wb")
+        online = _small_model()
+        tailer = RecordIOTailer(
+            shard, cursor_uri=os.path.join(tmp_path, "cursor.ckpt"),
+            name="part")
+        trainer = OnlineTrainer(online, tailer, n_features=N_F,
+                                chunk_rows=256, window_chunks=1,
+                                decay=1.0)
+        # 100 of 256 available: held, no fit, no trees, no commit
+        assert trainer.refresh(timeout=0.2) is None
+        assert len(getattr(online, "trees", ())) == 0
+        assert RecordIOTailer(shard, cursor_uri=os.path.join(
+            tmp_path, "cursor.ckpt"), name="replay").records_seen == 0
+        # the rest of the chunk arrives: pending + fresh = one full fit
+        _write_events(shard, XA[100:], yA[100:])
+        r = trainer.refresh(timeout=5.0)
+        assert r is not None and r["window_rows"] == 256
+        assert r["rows"] == 256
+        # a finite tail is trained only on explicit flush()
+        _write_events(shard, XA[:64], yA[:64])
+        assert trainer.refresh(timeout=0.2) is None
+        trees_before = len(online.trees)
+        f = trainer.flush()
+        assert f is not None and f["rows"] == 64
+        assert len(online.trees) > trees_before
+        assert trainer.flush() is None               # nothing pending
+
 
 # ---------------------------------------------------------------------------
 # Publisher
